@@ -1,0 +1,15 @@
+"""Parallelism layer: mesh topology, collectives, DP flavors, sequence parallel.
+
+This package is the TPU-native replacement for the reference's L1-L4 stack
+(gRPC PS transport, cluster topology, placement policy, sync/async
+optimization — SURVEY.md §1). Everything here is expressed as SPMD over a
+``jax.sharding.Mesh`` with XLA collectives; there is no parameter server and
+no per-role process launcher.
+"""
+
+from distributed_tensorflow_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    initialize_runtime,
+)
+from distributed_tensorflow_tpu.parallel import collectives  # noqa: F401

@@ -1,0 +1,123 @@
+"""First-class collective layer: XLA collectives over ICI/DCN.
+
+This module is the data path that replaces BOTH reference transports
+(SURVEY.md §2 native-component table):
+
+- the gRPC PS round-trip (pull variables / push gradients to per-variable
+  accumulators, SURVEY.md §3b hot loop) — gone entirely: parameters are
+  resident on-device and gradients are averaged with one fused AllReduce;
+- the NCCL ring allreduce (SURVEY.md §3d) — maps 1:1 to ``lax.psum`` over
+  the mesh's ICI links.
+
+All ``p*`` functions must run inside a context that binds the named axis —
+i.e. under ``shard_map`` (or an equivalent SPMD region). Tree variants apply
+leaf-wise over arbitrary pytrees (a whole gradient tree psums as one fused
+collective after XLA's combiner pass).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisName = str | Sequence[str]
+
+
+def psum_tree(tree, axis_name: AxisName):
+    """Sum every leaf across ``axis_name``. One AllReduce per fused group."""
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def pmean_tree(tree, axis_name: AxisName):
+    """Average every leaf across ``axis_name``.
+
+    This single call carries the full semantics of the reference's
+    ``SyncReplicasOptimizer`` (SURVEY.md §3b): "no update until
+    replicas_to_aggregate gradients arrive; gradients averaged; single global
+    step" — under SPMD the barrier, the accumulators, and the chief token
+    queue are all implied by the AllReduce itself.
+    """
+    return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def all_gather_tree(tree, axis_name: AxisName, axis: int = 0, tiled: bool = True):
+    """All-gather every leaf along ``axis`` across the named mesh axis."""
+    return jax.tree.map(
+        lambda x: lax.all_gather(x, axis_name, axis=axis, tiled=tiled), tree
+    )
+
+
+def reduce_scatter_mean_tree(tree, axis_name: AxisName, axis: int = 0):
+    """Reduce-scatter-mean: each shard ends with its slice of the mean.
+
+    The building block for sharded-optimizer (ZeRO-style) updates: grads are
+    reduce-scattered, the update runs on 1/N of the params, and params are
+    all-gathered — strictly less HBM traffic than AllReduce+full update.
+    """
+    n = lax.psum(1, axis_name)
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+        / n,
+        tree,
+    )
+
+
+def ppermute_ring(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the mesh axis ring (neighbor sends over ICI).
+
+    The primitive under ring attention and ring-based pipelining: on a TPU
+    torus, ``ppermute`` to (i+1) % n is a pure neighbor transfer and overlaps
+    with compute.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def axis_index(axis_name: AxisName):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: AxisName) -> int:
+    return lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Host-level placement helpers (outside shard_map): put pytrees on the mesh.
+# ---------------------------------------------------------------------------
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a host pytree onto every device of the mesh.
+
+    The SPMD analog of variable placement onto parameter servers
+    (``tf.train.replica_device_setter``, SURVEY.md §1 L3): instead of
+    round-robining variables across ps hosts, every chip holds the full
+    (or explicitly sharded) value and no remote read ever happens.
+    """
+    from distributed_tensorflow_tpu.parallel.mesh import replicated_sharding
+
+    return jax.device_put(tree, replicated_sharding(mesh))
+
+
+def shard_batch(tree, mesh: Mesh, axes: Sequence[str] | None = None):
+    """Shard a host batch along its leading dim over the DP mesh axes."""
+    from distributed_tensorflow_tpu.parallel.mesh import data_axes
+
+    if axes is None:
+        axes = data_axes(mesh)
+    spec = P(tuple(axes) if axes else None)
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def global_norm(tree) -> jax.Array:
+    """L2 norm over a full pytree (for grad-norm logging / clipping)."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )

@@ -1,0 +1,197 @@
+"""Device-mesh bootstrap: topology discovery for the single SPMD entrypoint.
+
+Replaces the reference's cluster-topology and launcher layers (SURVEY.md §1
+L2/L7, §3a-3b): where the reference declares ``tf.train.ClusterSpec({"ps":
+[...], "worker": [...]})`` and spawns one gRPC ``tf.train.Server`` per role
+via ``run_ps.py`` / ``run_worker.py``, here every host runs the *same*
+program, calls :func:`initialize_runtime` once, and builds a
+:class:`jax.sharding.Mesh` over all devices in the slice. Roles (ps/worker/
+chief) do not exist; parameters live replicated or sharded on the TPUs
+themselves, so the gRPC PS data path is eliminated by construction
+(BASELINE.json:5 "zero gRPC PS traffic").
+
+Mesh axis conventions used across the framework:
+
+- ``"data"``  — data parallelism (batch sharded, params replicated).
+- ``"model"`` — tensor/model parallelism (params sharded; optional).
+- ``"seq"``   — sequence/context parallelism for long-context attention
+  (ring attention over ICI neighbors; see ``parallel/ring_attention.py``).
+- ``"replica"`` — reserved for a DCN axis across slices (multi-slice DP).
+
+Within a slice, axes map onto ICI; across slices, put the outermost
+(pure-DP) axis on DCN — this is the standard multislice recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from collections.abc import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+# Canonical axis names, in the order they should appear in a mesh (outermost
+# first: slowest-varying ⇒ DCN/furthest devices, innermost ⇒ ICI neighbors).
+AXIS_ORDER = ("replica", "data", "pipeline", "expert", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape.
+
+    ``axes`` maps axis name -> size. At most one axis may be ``-1``, meaning
+    "all remaining devices". Axes of size 1 are kept (they are free and make
+    ``PartitionSpec``s uniform across configs).
+
+    Example::
+
+        MeshSpec({"data": -1})                      # pure DP over everything
+        MeshSpec({"data": -1, "seq": 4})            # DP x 4-way context parallel
+        MeshSpec({"replica": 2, "data": -1})        # 2 slices over DCN
+    """
+
+    axes: Mapping[str, int]
+
+    def __post_init__(self):
+        unknown = [a for a in self.axes if a not in AXIS_ORDER]
+        if unknown:
+            raise ValueError(
+                f"unknown mesh axes {unknown}; expected a subset of {AXIS_ORDER}"
+            )
+        wild = [a for a, n in self.axes.items() if n == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Return concrete sizes in canonical axis order, filling the -1 axis."""
+        fixed = 1
+        for a, n in self.axes.items():
+            if n != -1:
+                if n <= 0:
+                    raise ValueError(f"axis {a!r} must be positive or -1, got {n}")
+                fixed *= n
+        sizes = dict(self.axes)
+        wild = [a for a, n in self.axes.items() if n == -1]
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        else:
+            total = fixed
+            if total != n_devices:
+                raise ValueError(
+                    f"mesh {dict(self.axes)} needs {total} devices, have {n_devices}"
+                )
+        return {a: sizes[a] for a in AXIS_ORDER if a in sizes}
+
+
+_runtime_initialized = False
+
+
+def initialize_runtime(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize the multi-host JAX runtime (idempotent).
+
+    This is the entire replacement for the reference's per-role server
+    bootstrap (SURVEY.md §3a: ``tf.train.Server(cluster, "ps", k);
+    server.join()``): on TPU pods the coordinator/process topology comes from
+    the slice metadata automatically, so zero arguments are needed; explicit
+    arguments are accepted for CPU/GPU multi-process testing.
+
+    Must be called before anything touches the XLA backend (first ``jit`` /
+    ``jax.devices()``), exactly like ``jax.distributed.initialize`` itself.
+    With explicit arguments, failures propagate (a misconfigured cluster must
+    not silently fall back to single-process). With no arguments, cluster
+    auto-detection runs and single-host environments with no cluster metadata
+    fall back to single-process mode.
+
+    There is no ``server.join()`` analog because there are no passive
+    processes — every host executes the compiled SPMD program.
+    """
+    global _runtime_initialized
+    if _runtime_initialized:
+        return
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception as e:
+        if explicit:
+            raise
+        # No cluster metadata detected: single-process mode.
+        logger.info("single-process runtime (no cluster auto-detected): %s", e)
+    _runtime_initialized = True
+
+
+def build_mesh(
+    spec: MeshSpec | Mapping[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` from slice metadata.
+
+    Default is a 1-D ``"data"`` mesh over every addressable-or-not device in
+    the job — the SPMD collapse of the reference's whole ps/worker cluster
+    (SURVEY.md §1 "Key structural fact").
+
+    Devices are ordered so that the innermost mesh axes land on
+    ICI-contiguous neighbors (jax's default device order already follows the
+    physical torus for TPU).
+    """
+    if spec is None:
+        spec = MeshSpec({"data": -1})
+    elif not isinstance(spec, MeshSpec):
+        spec = MeshSpec(dict(spec))
+    if devices is None:
+        devices = jax.devices()
+    sizes = spec.resolve(len(devices))
+    names = tuple(sizes)
+    shape = tuple(sizes.values())
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=names)
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axes over which the global batch is sharded (DP-like axes)."""
+    return tuple(a for a in ("replica", "data") if a in mesh.axis_names)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 0) -> NamedSharding:
+    """Sharding for a batch: leading dim split over the DP axes, rest replicated.
+
+    ``ndim`` may be 0 (unknown); PartitionSpec only needs the leading entry.
+    """
+    axes = data_axes(mesh)
+    spec = P(axes if axes else None, *([None] * max(0, ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (the SPMD analog of PS-hosted variables —
+    except every chip holds a copy and no RecvTensor RPC exists,
+    SURVEY.md §2 native-component table row 1)."""
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    """Per-host slice of the global batch (for building host-local arrays)."""
+    n_data = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
+    if global_batch % n_data:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_data}")
+    per_device = global_batch // n_data
+    local_devices = sum(
+        1 for d in mesh.devices.flat if d.process_index == jax.process_index()
+    )
+    # Each host feeds its local devices' shards.
+    return per_device * max(1, local_devices * n_data // mesh.size)

@@ -1,0 +1,97 @@
+"""Collective-layer tests on 8 simulated devices (SURVEY.md §4, §7 step 2)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import collectives as coll
+
+
+def _smap(mesh, fn, in_specs, out_specs):
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+
+
+def test_pmean_tree_is_global_mean(data_mesh):
+    # Tree of two leaves, sharded over data; pmean must equal the global mean.
+    x = {
+        "a": np.arange(8 * 4, dtype=np.float32).reshape(8, 4),
+        "b": np.linspace(-1, 1, 8 * 2).astype(np.float32).reshape(8, 2),
+    }
+
+    def body(t):
+        # per-device shard -> pretend it's a local gradient; average globally
+        local = jax.tree.map(lambda v: v.sum(axis=0), t)
+        return coll.pmean_tree(local, "data")
+
+    out = _smap(
+        data_mesh, body, in_specs=({"a": P("data"), "b": P("data")},),
+        out_specs={"a": P(), "b": P()},
+    )(x)
+    for k in x:
+        per_dev = x[k].reshape(8, 1, -1).sum(axis=1)
+        np.testing.assert_allclose(np.asarray(out[k]), per_dev.mean(axis=0), rtol=1e-6)
+
+
+def test_psum_tree(data_mesh):
+    x = np.ones((8, 3), np.float32)
+
+    def body(v):
+        return coll.psum_tree(v.sum(axis=0), "data")
+
+    out = _smap(data_mesh, body, in_specs=(P("data"),), out_specs=P())(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(3, 8.0))
+
+
+def test_all_gather_tree_roundtrip(data_mesh):
+    x = np.arange(8 * 2, dtype=np.float32).reshape(8, 2)
+
+    def body(v):
+        return coll.all_gather_tree(v, "data", axis=0)
+
+    out = _smap(data_mesh, body, in_specs=(P("data"),), out_specs=P())(x)
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_reduce_scatter_mean_matches_pmean(data_mesh):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+
+    def body(v):
+        local = v[0]  # (16,) per-device vector
+        scattered = coll.reduce_scatter_mean_tree(local, "data", axis=0)
+        # gather back to compare against the full mean
+        return coll.all_gather_tree(scattered, "data", axis=0)
+
+    out = _smap(data_mesh, body, in_specs=(P("data"),), out_specs=P())(x)
+    np.testing.assert_allclose(np.asarray(out), x.mean(axis=0), rtol=1e-5)
+
+
+def test_ppermute_ring_rotates(data_mesh):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    def body(v):
+        return coll.ppermute_ring(v, "data", shift=1)
+
+    out = _smap(data_mesh, body, in_specs=(P("data"),), out_specs=P("data"))(x)
+    # device i's value goes to device i+1: output[i] = input[i-1]
+    np.testing.assert_array_equal(np.asarray(out).ravel(), np.roll(np.arange(8), 1))
+
+
+def test_replicate_and_shard_batch(data_mesh):
+    params = {"w": np.ones((4, 4), np.float32)}
+    rp = coll.replicate(params, data_mesh)
+    assert rp["w"].sharding.is_fully_replicated
+    batch = {"x": np.zeros((16, 3), np.float32)}
+    sb = coll.shard_batch(batch, data_mesh)
+    assert {s.data.shape for s in sb["x"].addressable_shards} == {(2, 3)}
+
+
+def test_global_norm():
+    tree = {"a": jnp.full((3,), 2.0), "b": jnp.full((4,), 1.0)}
+    np.testing.assert_allclose(float(coll.global_norm(tree)), np.sqrt(12 + 4))

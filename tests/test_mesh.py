@@ -1,0 +1,69 @@
+"""Mesh bootstrap tests (SURVEY.md §7 step 1)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    build_mesh,
+    data_axes,
+    local_batch_size,
+)
+
+
+def test_default_mesh_is_pure_dp(devices8):
+    mesh = build_mesh()
+    assert mesh.axis_names == ("data",)
+    assert mesh.shape["data"] == 8
+
+
+def test_mesh_spec_resolve_wildcard():
+    assert MeshSpec({"data": -1}).resolve(8) == {"data": 8}
+    assert MeshSpec({"data": -1, "seq": 4}).resolve(8) == {"data": 2, "seq": 4}
+    assert MeshSpec({"replica": 2, "data": -1}).resolve(8) == {
+        "replica": 2,
+        "data": 4,
+    }
+
+
+def test_mesh_spec_canonical_axis_order():
+    # Declaration order doesn't matter; canonical order does.
+    sizes = MeshSpec({"model": 2, "data": -1}).resolve(8)
+    assert list(sizes) == ["data", "model"]
+
+
+def test_mesh_spec_rejects_bad_axes():
+    with pytest.raises(ValueError):
+        MeshSpec({"bogus": 2})
+    with pytest.raises(ValueError):
+        MeshSpec({"data": -1, "seq": -1})
+    with pytest.raises(ValueError):
+        MeshSpec({"data": 3}).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec({"data": -1, "seq": 3}).resolve(8)
+
+
+def test_2d_mesh(devices8):
+    mesh = build_mesh({"data": 2, "seq": 4})
+    assert mesh.axis_names == ("data", "seq")
+    assert dict(mesh.shape) == {"data": 2, "seq": 4}
+    assert data_axes(mesh) == ("data",)
+
+
+def test_batch_sharding_splits_leading_dim(devices8):
+    mesh = build_mesh({"data": -1})
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = jax.device_put(x, batch_sharding(mesh, x.ndim))
+    # each of 8 devices holds 2 rows
+    shard_shapes = {s.data.shape for s in arr.addressable_shards}
+    assert shard_shapes == {(2, 3)}
+    np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_local_batch_size_single_host(devices8):
+    mesh = build_mesh({"data": -1})
+    assert local_batch_size(64, mesh) == 64  # single host feeds everything
+    with pytest.raises(ValueError):
+        local_batch_size(12, mesh)
