@@ -1,0 +1,19 @@
+"""Input pipelines: per-host sharded data feeding the SPMD step.
+
+Replaces the reference's per-workload input pipelines (SURVEY.md §2 "Input
+pipelines" row). Where each reference worker read its own shard and fed its
+own ``sess.run``, here each host materializes its slice of the global batch
+and assembles a global ``jax.Array`` over the mesh
+(``jax.make_array_from_process_local_data``) — same sharding idea, no
+per-role code.
+
+Real-dataset readers are gated on local file presence (this environment has
+zero egress); the synthetic generators produce seeded, learnably-structured
+data so convergence tests are meaningful without downloads.
+"""
+
+from distributed_tensorflow_tpu.data.synthetic import (  # noqa: F401
+    SyntheticClassification,
+    synthetic_image_classification,
+)
+from distributed_tensorflow_tpu.data.loader import device_batches  # noqa: F401

@@ -1,0 +1,65 @@
+"""Host→mesh batch assembly: the SPMD input path.
+
+The reference's workers each feed their local ``sess.run`` from a per-worker
+reader (SURVEY.md §3b); sharding is implicit in "each worker reads different
+files". Here sharding is explicit: each host builds its process-local slice
+of the global batch and the loader assembles one global ``jax.Array`` per
+leaf with the batch sharded over the DP mesh axes.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.data.synthetic import SyntheticClassification
+from distributed_tensorflow_tpu.parallel.mesh import batch_pspec, data_axes
+
+
+def device_batches(
+    dataset: SyntheticClassification,
+    mesh,
+    global_batch: int,
+    *,
+    seed: int = 0,
+) -> Iterator[dict]:
+    """Infinite iterator of global batches sharded over the mesh's DP axes.
+
+    Each epoch reshuffles with a deterministic per-epoch seed; the tail
+    examples that don't fill a global batch are dropped (static shapes only —
+    a partial batch would force an XLA recompile). In multi-host jobs every
+    host computes the same permutation (same seed) and takes its own
+    contiguous slice — the no-coordination equivalent of
+    ``tf.data.Dataset.shard(num_hosts, host_id)`` (SURVEY.md §7 step 5).
+    """
+    n = len(dataset)
+    if global_batch > n:
+        raise ValueError(f"global batch {global_batch} > dataset size {n}")
+    n_dp = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
+    if global_batch % n_dp:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by DP world size {n_dp}"
+        )
+    sharding = NamedSharding(mesh, batch_pspec(mesh))
+    n_proc = jax.process_count()
+    proc = jax.process_index()
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    local_b = global_batch // n_proc
+    epoch = 0
+    while True:
+        order = np.random.default_rng(seed + epoch).permutation(n)
+        for start in range(0, n - global_batch + 1, global_batch):
+            idx = order[start + proc * local_b : start + (proc + 1) * local_b]
+            local = {
+                "image": dataset.images[idx],
+                "label": dataset.labels[idx],
+            }
+            yield {
+                k: jax.make_array_from_process_local_data(sharding, v)
+                for k, v in local.items()
+            }
+        epoch += 1

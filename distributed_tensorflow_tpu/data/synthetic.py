@@ -1,0 +1,43 @@
+"""Seeded synthetic datasets with learnable class structure.
+
+Stand-ins for MNIST/CIFAR/ImageNet in a zero-egress environment: each class
+gets a fixed random template; samples are template + noise, so a real model
+trained on them converges (loss falls, accuracy rises) — which is what the
+reference's own validation strategy ("run it and watch the loss",
+SURVEY.md §4) needs from its data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SyntheticClassification:
+    """In-memory synthetic image-classification dataset."""
+
+    images: np.ndarray  # [N,H,W,C] float32
+    labels: np.ndarray  # [N] int32
+
+    def __len__(self):
+        return len(self.labels)
+
+
+def synthetic_image_classification(
+    num_examples: int,
+    image_shape: tuple[int, int, int],
+    num_classes: int,
+    *,
+    seed: int = 0,
+    noise: float = 0.5,
+) -> SyntheticClassification:
+    """Class-template images + Gaussian noise; linearly separable-ish."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(size=(num_classes, *image_shape)).astype(np.float32)
+    labels = rng.integers(0, num_classes, size=num_examples).astype(np.int32)
+    images = templates[labels] + noise * rng.normal(
+        size=(num_examples, *image_shape)
+    ).astype(np.float32)
+    return SyntheticClassification(images=images.astype(np.float32), labels=labels)

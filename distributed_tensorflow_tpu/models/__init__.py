@@ -1,0 +1,16 @@
+"""Model zoo: the reference's five parity workloads, in flax.linen.
+
+Mirrors SURVEY.md §2 workload rows / BASELINE.json "configs":
+
+- LeNet-5 (MNIST, single-chip sanity — SURVEY.md §3e)
+- ResNet-20 (CIFAR-10, sync DP) and ResNet-50 (ImageNet, the north-star)
+- Inception-v3 (ImageNet, async-stale flavor)
+- BERT-base (pretraining, MLM+NSP; large embedding allreduce)
+
+All models are pure graph-builders like the reference's ``inference()``/
+``loss()`` functions (SURVEY.md §1 L5) — but as flax modules whose params are
+an explicit pytree, so placement is a sharding annotation instead of a
+``replica_device_setter`` device scope.
+"""
+
+from distributed_tensorflow_tpu.models.lenet import LeNet5  # noqa: F401

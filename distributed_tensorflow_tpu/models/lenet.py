@@ -1,0 +1,33 @@
+"""LeNet-5 — the MNIST sanity workload (SURVEY.md §3e, BASELINE.json:7).
+
+The reference uses this as its single-process sync-SGD floor: a conv/pool/fc
+graph built by ``inference(images) -> logits`` functions. Same capability
+here as a flax module; the classic LeCun-98 shape (6-16-120-84-10) on 28x28
+inputs with SAME padding on the first conv.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class LeNet5(nn.Module):
+    """Classic LeNet-5 for 28x28x1 MNIST images, NHWC."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype, name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

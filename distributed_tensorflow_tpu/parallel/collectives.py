@@ -101,18 +101,18 @@ def replicate(tree, mesh: Mesh):
     """
     from distributed_tensorflow_tpu.parallel.mesh import replicated_sharding
 
-    return jax.device_put(tree, replicated_sharding(mesh))
+    return jax.device_put(tree, replicated_sharding(mesh))  # one batched dispatch
 
 
 def shard_batch(tree, mesh: Mesh, axes: Sequence[str] | None = None):
     """Shard a host batch along its leading dim over the DP mesh axes."""
-    from distributed_tensorflow_tpu.parallel.mesh import data_axes
+    from distributed_tensorflow_tpu.parallel.mesh import batch_pspec
 
     if axes is None:
-        axes = data_axes(mesh)
-    spec = P(tuple(axes) if axes else None)
-    sharding = NamedSharding(mesh, spec)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+        spec = batch_pspec(mesh)
+    else:
+        spec = P(tuple(axes) if axes else None)
+    return jax.device_put(tree, NamedSharding(mesh, spec))
 
 
 def global_norm(tree) -> jax.Array:

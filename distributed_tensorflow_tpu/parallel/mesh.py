@@ -128,11 +128,30 @@ def initialize_runtime(
             process_id=process_id,
         )
     except Exception as e:
-        if explicit:
+        if explicit or _cluster_env_present():
+            # A declared or detected cluster that fails to initialize must
+            # never silently degrade to N independent single-process jobs.
             raise
-        # No cluster metadata detected: single-process mode.
-        logger.info("single-process runtime (no cluster auto-detected): %s", e)
+        logger.info("single-process runtime (no cluster metadata): %s", e)
     _runtime_initialized = True
+
+
+# Environment markers that indicate this process is part of a multi-host
+# cluster; if any is set, an init failure is a real error, not a fallback.
+_CLUSTER_ENV_VARS = (
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+    "TPU_WORKER_ID",
+    "SLURM_JOB_NUM_NODES",
+    "OMPI_COMM_WORLD_SIZE",
+)
+
+
+def _cluster_env_present() -> bool:
+    import os
+
+    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
 
 
 def build_mesh(
@@ -167,14 +186,24 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in ("replica", "data") if a in mesh.axis_names)
 
 
+def batch_pspec(mesh: Mesh) -> P:
+    """The canonical batch PartitionSpec: leading dim over the DP axes.
+
+    Single source of truth for the DP-batch rule — used by the data loader,
+    the train/eval steps, and ``batch_sharding``.
+    """
+    axes = data_axes(mesh)
+    return P(axes if axes else None)
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 0) -> NamedSharding:
     """Sharding for a batch: leading dim split over the DP axes, rest replicated.
 
-    ``ndim`` may be 0 (unknown); PartitionSpec only needs the leading entry.
+    ``ndim`` is accepted for readability at call sites but unused:
+    PartitionSpec only needs the leading entry.
     """
-    axes = data_axes(mesh)
-    spec = P(axes if axes else None, *([None] * max(0, ndim - 1)))
-    return NamedSharding(mesh, spec)
+    del ndim
+    return NamedSharding(mesh, batch_pspec(mesh))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:

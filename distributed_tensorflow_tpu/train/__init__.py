@@ -1,0 +1,13 @@
+"""Training engine: the compiled SPMD train step and driver loop.
+
+Replaces the reference's L4+L6 stack (SURVEY.md §1): the
+``SyncReplicasOptimizer`` / per-worker ``apply_gradients`` machinery and the
+``MonitoredTrainingSession`` ``sess.run`` loop. The entire per-step diagram of
+SURVEY.md §3b (pull variables ⇄ compute ⇄ push gradients ⇄ accumulate ⇄
+token barrier) collapses into ONE jit-compiled function with a single fused
+AllReduce inside it.
+"""
+
+from distributed_tensorflow_tpu.train.state import TrainState, create_train_state  # noqa: F401
+from distributed_tensorflow_tpu.train.step import make_train_step, make_eval_step  # noqa: F401
+from distributed_tensorflow_tpu.train.loop import fit  # noqa: F401

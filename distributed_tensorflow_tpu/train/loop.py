@@ -1,0 +1,74 @@
+"""Training driver loop — the replacement for MonitoredTrainingSession.
+
+The reference's L6 (SURVEY.md §1): ``MonitoredTrainingSession`` + hooks +
+``while not sess.should_stop(): sess.run(train_op)``. Here the loop is plain
+Python around one compiled step; hooks become plain callables; there is no
+chief (every host runs the identical loop; host-dependent work like metric
+printing is gated on ``jax.process_index() == 0``).
+
+TPU-first detail: the loop never blocks on device values except at the
+logging cadence — metrics come back as device arrays and are only fetched
+every ``log_every`` steps, keeping the step stream fully async.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections.abc import Callable, Iterable, Iterator
+from typing import Any
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+# hook(step: int, state, metrics: dict[str, float]) -> None, called at log cadence
+Hook = Callable[[int, Any, dict], None]
+
+
+def fit(
+    state,
+    train_step,
+    data: Iterable,
+    *,
+    num_steps: int,
+    rng: jax.Array | None = None,
+    log_every: int = 100,
+    hooks: tuple[Hook, ...] = (),
+    checkpointer=None,
+    ckpt_every: int = 0,
+):
+    """Run the training loop; returns the final state.
+
+    ``data`` yields already-placed global batches (see ``data`` package).
+    ``checkpointer``/``ckpt_every`` wire in periodic async checkpointing —
+    the analog of the reference chief's periodic ``tf.train.Saver`` writes
+    (SURVEY.md §5 checkpoint row), minus the chief: saving is collective.
+    """
+    if rng is None:
+        rng = jax.random.key(0)
+    it: Iterator = iter(data)
+    pending_metrics = None
+    t0 = time.perf_counter()
+    start_step = int(state.step)
+    for step in range(start_step, num_steps):
+        batch = next(it)
+        state, metrics = train_step(state, batch, rng)
+        if log_every and ((step + 1) % log_every == 0 or step + 1 == num_steps):
+            # Fetch (blocks on the step stream only here).
+            fetched = {k: float(v) for k, v in metrics.items()}
+            dt = time.perf_counter() - t0
+            steps_done = step + 1 - start_step
+            fetched["steps_per_sec"] = steps_done / dt if dt > 0 else 0.0
+            if jax.process_index() == 0:
+                logger.info(
+                    "step %d: %s",
+                    step + 1,
+                    " ".join(f"{k}={v:.5g}" for k, v in sorted(fetched.items())),
+                )
+            for hook in hooks:
+                hook(step + 1, state, fetched)
+            pending_metrics = fetched
+        if checkpointer is not None and ckpt_every and (step + 1) % ckpt_every == 0:
+            checkpointer.save(step + 1, state)
+    return state, pending_metrics

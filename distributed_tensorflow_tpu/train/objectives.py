@@ -1,0 +1,72 @@
+"""Standard objectives: classification loss/metric builders over flax models.
+
+The reference pairs each model with ``loss(logits, labels)`` graph-builders
+(SURVEY.md §1 L5). Here one builder covers all image-classification
+workloads; BERT's MLM+NSP objective lives with the model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_classification_loss(model, *, label_smoothing: float = 0.0):
+    """Return a ``LossFn`` for a flax classifier.
+
+    Expects batches ``{"image": [B,H,W,C], "label": [B] int}``. Handles
+    mutable ``batch_stats`` (BN models) and a ``dropout`` rng.
+    """
+
+    def loss_fn(params, model_state, batch, rng):
+        variables = {"params": params, **model_state}
+        mutable = [k for k in model_state if k != "params"]
+        if mutable:
+            logits, new_model_state = model.apply(
+                variables,
+                batch["image"],
+                train=True,
+                mutable=mutable,
+                rngs={"dropout": rng},
+            )
+        else:
+            logits = model.apply(
+                variables, batch["image"], train=True, rngs={"dropout": rng}
+            )
+            new_model_state = model_state
+        labels = batch["label"]
+        onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+        if label_smoothing:
+            n = logits.shape[-1]
+            onehot = onehot * (1.0 - label_smoothing) + label_smoothing / n
+        loss = optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot).mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, (new_model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def make_classification_metrics(model):
+    """Return a ``metric_fn`` for eval: loss + accuracy, no mutation."""
+
+    def metric_fn(params, model_state, batch):
+        variables = {"params": params, **model_state}
+        logits = model.apply(variables, batch["image"], train=False)
+        labels = batch["label"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return {"loss": loss, "accuracy": acc}
+
+    return metric_fn
+
+
+def init_model(model, rng, sample_batch, **kwargs) -> tuple[Any, Any]:
+    """Initialize a flax model; returns ``(params, model_state)``."""
+    variables = model.init(rng, sample_batch, train=False, **kwargs)
+    params = variables.pop("params")
+    return params, dict(variables)

@@ -1,0 +1,70 @@
+"""Train state: everything the compiled step reads and writes.
+
+The reference scatters this state across processes — variables on ps shards,
+optimizer slots beside them, ``global_step`` on the chief, SyncReplicas
+accumulators in the ps graph (SURVEY.md §3b). Here it is one pytree, resident
+on the mesh, threaded functionally through the jit'd step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    """One pytree holding the full training state.
+
+    Attributes:
+      step: global step — the single global step of SURVEY.md §3b, but with
+        no chief to own it: every device holds the same replicated scalar.
+      params: model parameters.
+      opt_state: optax optimizer state (momentum/Adam slots — the analog of
+        the reference's ps-hosted slot variables).
+      model_state: mutable model collections (flax ``batch_stats`` for BN).
+      grad_buffer: ``None`` for sync DP; for the async-stale flavor, a
+        K-deep ring buffer of past aggregated gradients (leading dim K)
+        emulating PS staleness deterministically (SURVEY.md §7 hard-part 1).
+      buffer_index: next slot to overwrite in ``grad_buffer``.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    model_state: Any = struct.field(default_factory=dict)
+    grad_buffer: Any = None
+    buffer_index: jax.Array | None = None
+
+
+def create_train_state(
+    params,
+    tx: optax.GradientTransformation,
+    model_state: Any = None,
+    staleness: int = 0,
+) -> TrainState:
+    """Build an initial :class:`TrainState` on host (place with ``replicate``).
+
+    ``staleness=K > 0`` pre-allocates the K-deep zero gradient ring buffer for
+    the async-stale flavor: the first K applied updates are zero, exactly like
+    a PS whose workers haven't delivered yet (SURVEY.md §3c).
+    """
+    grad_buffer = None
+    buffer_index = None
+    if staleness > 0:
+        grad_buffer = jax.tree.map(
+            lambda p: jnp.zeros((staleness,) + p.shape, p.dtype), params
+        )
+        buffer_index = jnp.zeros((), jnp.int32)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        model_state=model_state if model_state is not None else {},
+        grad_buffer=grad_buffer,
+        buffer_index=buffer_index,
+    )

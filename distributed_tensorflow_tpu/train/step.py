@@ -1,0 +1,206 @@
+"""The compiled SPMD train step — sync-DP, async-stale-DP, and eval.
+
+This one module supersedes all three data-parallel flavors of the reference
+(SURVEY.md §2 parallelism inventory):
+
+- **sync PS** (``SyncReplicasOptimizer``, SURVEY.md §3b) and **sync NCCL
+  allreduce** (SURVEY.md §3d) both become ``mode="sync"``: gradients are
+  ``lax.pmean``'d across the DP mesh axes inside the compiled step. The
+  accumulators, chief token queue, and worker barrier are implied by the
+  AllReduce; the NCCL ring becomes the ICI ring XLA lowers psum onto.
+- **async PS with stale gradients** (SURVEY.md §3c) becomes
+  ``mode="stale"``: a deterministic K-step delayed-gradient ring buffer.
+  True PS asynchrony (races on variable state) cannot exist under SPMD —
+  the emulation preserves the *statistical* property the workload stresses
+  (updates computed against K-step-old information) while staying
+  reproducible and testable. The divergence is documented, deliberate, and
+  strictly better for debugging (SURVEY.md §7 hard-part 1).
+
+Design notes (TPU-first):
+- The step is built with ``shard_map`` over the mesh so every collective is
+  explicit, then ``jit``'d with buffer donation: params/opt-state update in
+  place in HBM, and XLA fuses the pmean into the backward pass.
+- Loss functions should compute in bf16 where possible and return f32
+  scalars; the engine does not impose a dtype policy.
+- Nothing in the step depends on Python-level step count or data values —
+  one trace, one executable, zero retraces across the run.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel.mesh import batch_pspec, data_axes
+from distributed_tensorflow_tpu.train.state import TrainState
+
+# loss_fn(params, model_state, batch, rng) -> (loss, (new_model_state, metrics))
+LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[Any, dict]]]
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh,
+    *,
+    mode: str = "sync",
+    staleness: int = 0,
+    batch_spec: P | None = None,
+    donate: bool = True,
+):
+    """Build the compiled ``train_step(state, batch, rng) -> (state, metrics)``.
+
+    Args:
+      loss_fn: ``(params, model_state, batch, rng) -> (loss, (model_state,
+        metrics))``. Runs on the per-device batch shard; the engine averages
+        gradients/metrics/model_state across the DP axes.
+      tx: optax transformation (the inner optimizer the reference would wrap
+        in SyncReplicasOptimizer, SURVEY.md §1 L4).
+      mesh: the device mesh; DP axes are ``("replica", "data")`` ∩ mesh axes.
+      mode: ``"sync"`` or ``"stale"`` (K-step delayed gradients).
+      staleness: K for ``mode="stale"``; state must be created with the same K.
+      batch_spec: PartitionSpec for batch leaves; default: leading dim over
+        the DP axes (replicated along any other mesh axes).
+      donate: donate state buffers so params update in place in HBM.
+    """
+    if mode not in ("sync", "stale"):
+        raise ValueError(f"mode must be 'sync' or 'stale', got {mode!r}")
+    if mode == "stale" and staleness < 1:
+        raise ValueError("mode='stale' requires staleness >= 1")
+    dp_axes = data_axes(mesh)
+    if batch_spec is None:
+        batch_spec = batch_pspec(mesh)
+
+    def per_device_step(state: TrainState, batch, rng: jax.Array):
+        if mode == "stale":
+            # Trace-time state validation: XLA clamps out-of-range dynamic
+            # indices silently, so a buffer/staleness mismatch would corrupt
+            # training with no error. Shapes are static — check here.
+            if state.grad_buffer is None:
+                raise ValueError(
+                    "mode='stale' needs a state built with create_train_state"
+                    f"(..., staleness={staleness})"
+                )
+            depth = jax.tree.leaves(state.grad_buffer)[0].shape[0]
+            if depth != staleness:
+                raise ValueError(
+                    f"state.grad_buffer depth {depth} != staleness {staleness}"
+                )
+        # Per-device RNG: fold in the global step and the device's DP
+        # coordinate so dropout/augmentation differ per step and per shard.
+        rng = jax.random.fold_in(rng, state.step)
+        for ax in dp_axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (model_state, metrics)), grads = grad_fn(
+            state.params, state.model_state, batch, rng
+        )
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+
+        if dp_axes:
+            # THE sync point: one fused AllReduce over ICI replaces the
+            # reference's entire ps round-trip / NCCL ring (SURVEY.md §3b/3d).
+            grads = coll.pmean_tree(grads, dp_axes)
+            metrics = coll.pmean_tree(metrics, dp_axes)
+            if model_state:
+                model_state = coll.pmean_tree(model_state, dp_axes)
+
+        new_buffer, new_index = state.grad_buffer, state.buffer_index
+        if mode == "stale":
+            # Ring buffer: apply the gradient from K steps ago, store the
+            # fresh one in its slot — the deterministic image of async-PS
+            # staleness (SURVEY.md §3c: "updates computed against stale
+            # weights"; here the staleness is exactly K instead of a race).
+            idx = state.buffer_index
+            apply_grads = jax.tree.map(
+                lambda buf: lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False),
+                state.grad_buffer,
+            )
+            new_buffer = jax.tree.map(
+                lambda buf, g: lax.dynamic_update_index_in_dim(
+                    buf, g.astype(buf.dtype), idx, 0
+                ),
+                state.grad_buffer,
+                grads,
+            )
+            new_index = (idx + 1) % staleness
+            grads = apply_grads
+            metrics["staleness"] = jnp.asarray(staleness, jnp.float32)
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = coll.global_norm(grads)
+
+        new_state = TrainState(
+            step=state.step + 1,
+            params=params,
+            opt_state=opt_state,
+            model_state=model_state,
+            grad_buffer=new_buffer,
+            buffer_index=new_index,
+        )
+        return new_state, metrics
+
+    # State/rng replicated; batch sharded over DP axes. Outputs replicated —
+    # identical on every device by construction (same reduced grads, same
+    # update), which is exactly the post-allreduce invariant of SURVEY.md §3d.
+    smapped = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(P(), batch_spec, P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    metric_fn: Callable[[Any, Any, Any], dict],
+    mesh,
+    *,
+    batch_spec: P | None = None,
+):
+    """Build ``eval_step(state, batch) -> metrics`` (metrics pmean'd over DP).
+
+    ``metric_fn(params, model_state, batch) -> dict`` runs on the shard; the
+    engine averages. The reference had no eval path beyond running the train
+    graph without the train op (SURVEY.md §5) — this is the deliberate
+    do-better (SURVEY.md §4 "Consequence for the rebuild").
+    """
+    dp_axes = data_axes(mesh)
+    if batch_spec is None:
+        batch_spec = batch_pspec(mesh)
+
+    def per_device_eval(state: TrainState, batch):
+        metrics = metric_fn(state.params, state.model_state, batch)
+        if dp_axes:
+            metrics = coll.pmean_tree(dict(metrics), dp_axes)
+        return metrics
+
+    smapped = jax.shard_map(
+        per_device_eval,
+        mesh=mesh,
+        in_specs=(P(), batch_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(smapped)
+
+
+def place_state(state: TrainState, mesh) -> TrainState:
+    """Put a host-built TrainState onto the mesh, replicated.
+
+    (With a ``model`` axis in play, params would get sharded specs instead;
+    replicated is the DP-parity layout — SURVEY.md §2 inventory.)
+    """
+    return jax.device_put(state, NamedSharding(mesh, P()))

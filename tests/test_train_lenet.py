@@ -1,0 +1,97 @@
+"""End-to-end slice tests: LeNet-5 + sync DP engine (SURVEY.md §7 min slice).
+
+Includes the central sync-DP correctness invariant from SURVEY.md §4:
+N-way sync DP must equal 1-device training with an N-times batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.data import (
+    device_batches,
+    synthetic_image_classification,
+)
+from distributed_tensorflow_tpu.models import LeNet5
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_tpu.train import create_train_state, fit, make_train_step
+from distributed_tensorflow_tpu.train.objectives import (
+    init_model,
+    make_classification_loss,
+    make_classification_metrics,
+)
+from distributed_tensorflow_tpu.train.step import make_eval_step, place_state
+
+
+def _setup(mesh, lr=0.05):
+    model = LeNet5()
+    sample = jnp.zeros((2, 28, 28, 1), jnp.float32)
+    params, model_state = init_model(model, jax.random.key(0), sample)
+    tx = optax.sgd(lr, momentum=0.9)
+    state = place_state(create_train_state(params, tx, model_state), mesh)
+    loss_fn = make_classification_loss(model)
+    step = make_train_step(loss_fn, tx, mesh)
+    return model, state, step
+
+
+def test_lenet_param_count():
+    model = LeNet5()
+    params, _ = init_model(model, jax.random.key(0), jnp.zeros((1, 28, 28, 1)))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert n == 61_706  # classic LeCun-98 LeNet-5 on 28x28
+
+
+def test_sync_dp_equals_big_batch(devices8):
+    """8-way sync DP step-for-step ≡ single-device 8x batch (SURVEY.md §4)."""
+    ds = synthetic_image_classification(512, (28, 28, 1), 10, seed=1)
+    mesh8 = build_mesh({"data": -1})
+    mesh1 = build_mesh({"data": 1}, devices=jax.devices()[:1])
+
+    losses = {}
+    params_after = {}
+    for name, mesh in [("dp8", mesh8), ("single", mesh1)]:
+        _, state, step = _setup(mesh)
+        batches = device_batches(ds, mesh, global_batch=64, seed=7)
+        rng = jax.random.key(42)
+        ls = []
+        for _ in range(5):
+            state, metrics = step(state, next(batches), rng)
+            ls.append(float(metrics["loss"]))
+        losses[name] = ls
+        params_after[name] = jax.tree.map(np.asarray, jax.device_get(state.params))
+
+    np.testing.assert_allclose(losses["dp8"], losses["single"], rtol=2e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
+        params_after["dp8"],
+        params_after["single"],
+    )
+
+
+def test_lenet_converges_on_synthetic(devices8):
+    """The reference's own validation idiom: run it, watch loss fall (§4)."""
+    ds = synthetic_image_classification(2048, (28, 28, 1), 10, seed=2, noise=0.7)
+    mesh = build_mesh({"data": -1})
+    model, state, step = _setup(mesh, lr=0.05)
+    batches = device_batches(ds, mesh, global_batch=128, seed=3)
+    state, last = fit(
+        state, step, batches, num_steps=60, rng=jax.random.key(0), log_every=30
+    )
+    assert last is not None
+    assert last["loss"] < 0.5, f"did not converge: {last}"
+    assert last["accuracy"] > 0.85, f"low accuracy: {last}"
+
+    eval_step = make_eval_step(make_classification_metrics(model), mesh)
+    ev = eval_step(state, next(batches))
+    assert float(ev["accuracy"]) > 0.85
+
+
+def test_train_step_rejects_bad_mode(data_mesh):
+    with pytest.raises(ValueError):
+        make_train_step(lambda *a: None, optax.sgd(0.1), data_mesh, mode="nope")
+    with pytest.raises(ValueError):
+        make_train_step(
+            lambda *a: None, optax.sgd(0.1), data_mesh, mode="stale", staleness=0
+        )
