@@ -14,3 +14,8 @@ an explicit pytree, so placement is a sharding annotation instead of a
 """
 
 from distributed_tensorflow_tpu.models.lenet import LeNet5  # noqa: F401
+from distributed_tensorflow_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet20,
+    ResNet50,
+)

@@ -1,0 +1,174 @@
+"""ResNet family: ResNet-20 (CIFAR-10) and ResNet-50 (ImageNet).
+
+Parity targets (SURVEY.md §2 workload rows):
+
+- ResNet-20 is the reference's 2-worker ``SyncReplicasOptimizer`` PS workload
+  (BASELINE.json:8) — the CIFAR-style residual net of He et al. 2015 §4.2:
+  three stages of n=3 basic blocks at widths 16/32/64, ~0.27M params.
+- ResNet-50 is the north-star benchmark model (BASELINE.json:2,5,9): the
+  bottleneck ImageNet net, ~25.6M params, trained 8-worker sync-allreduce in
+  the reference (SURVEY.md §3d) — here sync DP via ``lax.pmean`` in the
+  compiled step.
+
+TPU-first design notes:
+
+- NHWC layout and 3x3/1x1 convs map directly onto the MXU via XLA:TPU's
+  convolution tiling; compute dtype is a knob (bf16 recommended) while params
+  and BN statistics stay f32.
+- BatchNorm uses flax's ``batch_stats`` collection. Cross-replica stat
+  handling follows the engine contract: the train step pmeans the updated
+  ``batch_stats`` across the DP axes every step (train/step.py), which keeps
+  replicas bit-identical — the invariant of SURVEY.md §3d. Per-shard ghost
+  batch norm is therefore the normalization semantics (SURVEY.md §7
+  hard-part 5), matching per-worker BN in the reference's multi-worker runs.
+- ``kernel_init`` is He-normal like the reference era's MSRA init.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from functools import partial
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Callable[..., nn.Module]
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (CIFAR ResNets)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        # Zero-init'd final-BN scale: residual branches start as identity,
+        # the standard large-batch ResNet trick (Goyal et al.) — pure win on
+        # sync-DP convergence, no API cost.
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides,) * 2, name="proj"
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 down / 3x3 / 1x1 up (x4) bottleneck block (ImageNet ResNets)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides,) * 2, name="proj"
+            )(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Generic residual network over NHWC inputs.
+
+    ``stem="imagenet"`` → 7x7/2 conv + 3x3/2 maxpool (ResNet-50 et al.);
+    ``stem="cifar"``    → single 3x3 conv (ResNet-20/32/...).
+    """
+
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_filters: int = 64
+    num_classes: int = 1000
+    stem: str = "imagenet"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.he_normal(),
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            # Explicit symmetric padding (pad-3 conv, pad-1 pool): SAME would
+            # compute asymmetric (2,3)/(0,1) pads on stride-2 and silently
+            # shift activations vs. the canonical ResNet-50.
+            x = conv(
+                self.num_filters,
+                (7, 7),
+                strides=(2, 2),
+                padding=[(3, 3), (3, 3)],
+                name="stem_conv",
+            )(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        elif self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block(
+                    self.num_filters * 2**i, strides=strides, conv=conv, norm=norm
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # Head computes in f32: the logits/loss edge is where bf16 hurts.
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def ResNet20(num_classes: int = 10, dtype=jnp.float32) -> ResNet:
+    """He et al. CIFAR ResNet, n=3: 6n+2 = 20 layers, ~0.27M params."""
+    return ResNet(
+        stage_sizes=(3, 3, 3),
+        block=BasicBlock,
+        num_filters=16,
+        num_classes=num_classes,
+        stem="cifar",
+        dtype=dtype,
+    )
+
+
+def ResNet50(num_classes: int = 1000, dtype=jnp.float32) -> ResNet:
+    """Bottleneck ImageNet ResNet-50, ~25.6M params — the north-star model."""
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        block=BottleneckBlock,
+        num_filters=64,
+        num_classes=num_classes,
+        stem="imagenet",
+        dtype=dtype,
+    )
