@@ -1,0 +1,118 @@
+"""Headline benchmark: ResNet-50 sync-DP training throughput on TPU.
+
+Measures the north-star metric (BASELINE.json:2 "ResNet-50 ImageNet
+images/sec/chip") on whatever devices are visible: full train step
+(fwd+bwd+psum+SGD update), bf16 compute, donated buffers, 224x224 synthetic
+images (data content doesn't affect throughput; ImageNet isn't downloadable
+here).
+
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+``vs_baseline`` is measured MFU / 0.55 — the reference repo publishes no
+numbers (BASELINE.json "published": {}, SURVEY.md §6), so the ≥55% MFU
+target from BASELINE.json:5 is the baseline bar.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# ResNet-50 at 224x224: ~4.09 GFLOP forward per image (the standard count);
+# fwd+bwd ~= 3x forward.
+FLOPS_PER_IMAGE = 3 * 4.09e9
+
+# Known per-chip peak bf16 FLOP/s for MFU accounting; fall back to v5e.
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return 197e12
+
+
+def main():
+    from distributed_tensorflow_tpu.data import synthetic_image_classification
+    from distributed_tensorflow_tpu.models import ResNet50
+    from distributed_tensorflow_tpu.parallel import collectives as coll
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+    from distributed_tensorflow_tpu.train.objectives import (
+        init_model,
+        make_classification_loss,
+    )
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = devices[0].platform == "tpu"
+    per_chip_batch = 64 if on_tpu else 8
+    image_hw = 224 if on_tpu else 64
+    global_batch = per_chip_batch * n
+
+    mesh = build_mesh({"data": -1})
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((1, image_hw, image_hw, 3), jnp.float32)
+    )
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = place_state(create_train_state(params, tx, model_state), mesh)
+    step = make_train_step(make_classification_loss(model), tx, mesh)
+
+    ds = synthetic_image_classification(
+        global_batch, (image_hw, image_hw, 3), 1000, seed=0
+    )
+    batch = coll.shard_batch({"image": ds.images, "label": ds.labels}, mesh)
+    rng = jax.random.key(0)
+
+    # Warmup: compile + 2 steady steps. Synchronization note: on the tunneled
+    # TPU platform here, block_until_ready returns before the computation
+    # drains, so every timed region ends with a value fetch of a metric that
+    # data-depends on the whole donated-state chain — that is a true barrier.
+    for _ in range(3):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+
+    n_steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch, rng)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = n_steps * global_batch / dt
+    images_per_sec_chip = images_per_sec / n
+    # MFU accounting is defined for the 224x224 workload; scale FLOPs if the
+    # CPU-smoke path shrank the image (conv FLOPs ~ HW^2).
+    flops_per_image = FLOPS_PER_IMAGE * (image_hw / 224) ** 2
+    mfu = images_per_sec_chip * flops_per_image / chip_peak_flops(devices[0])
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": round(images_per_sec_chip, 2),
+                "unit": f"images/sec/chip (bf16, b={per_chip_batch}/chip, "
+                f"{image_hw}x{image_hw}, {n}x {devices[0].device_kind}, "
+                f"mfu={mfu:.3f})",
+                "vs_baseline": round(mfu / 0.55, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

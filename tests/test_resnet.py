@@ -107,3 +107,12 @@ def test_resnet20_sync_dp_trains(devices8):
         )
     )
     assert max(diffs) > 0, "batch_stats never updated"
+    # Replication is asserted per-device, not assumed: out_specs=P() with
+    # check_vma=False would assemble from one shard even if devices diverged,
+    # so compare every device's copy of the stats bit-for-bit.
+    for leaf in jax.tree.leaves(state.model_state):
+        shards = leaf.addressable_shards
+        assert len(shards) == 8
+        ref = np.asarray(shards[0].data)
+        for s in shards[1:]:
+            np.testing.assert_array_equal(ref, np.asarray(s.data))
