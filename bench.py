@@ -37,12 +37,13 @@ PEAK_FLOPS = {
 }
 
 
-def chip_peak_flops(device) -> float:
+def chip_peak_flops(device) -> tuple[float, bool]:
+    """Return (per-chip peak bf16 FLOP/s, whether it was a known match)."""
     kind = getattr(device, "device_kind", "").lower()
     for key, peak in PEAK_FLOPS.items():
         if key in kind:
-            return peak
-    return 197e12
+            return peak, True
+    return 197e12, False
 
 
 def main():
@@ -99,7 +100,9 @@ def main():
     # MFU accounting is defined for the 224x224 workload; scale FLOPs if the
     # CPU-smoke path shrank the image (conv FLOPs ~ HW^2).
     flops_per_image = FLOPS_PER_IMAGE * (image_hw / 224) ** 2
-    mfu = images_per_sec_chip * flops_per_image / chip_peak_flops(devices[0])
+    peak, known = chip_peak_flops(devices[0])
+    mfu = images_per_sec_chip * flops_per_image / peak
+    peak_note = f"peak={peak / 1e12:.0f}T" + ("" if known else " ASSUMED")
     print(
         json.dumps(
             {
@@ -107,7 +110,7 @@ def main():
                 "value": round(images_per_sec_chip, 2),
                 "unit": f"images/sec/chip (bf16, b={per_chip_batch}/chip, "
                 f"{image_hw}x{image_hw}, {n}x {devices[0].device_kind}, "
-                f"mfu={mfu:.3f})",
+                f"mfu={mfu:.3f}, {peak_note})",
                 "vs_baseline": round(mfu / 0.55, 4),
             }
         )

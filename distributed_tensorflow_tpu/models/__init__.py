@@ -19,3 +19,4 @@ from distributed_tensorflow_tpu.models.resnet import (  # noqa: F401
     ResNet20,
     ResNet50,
 )
+from distributed_tensorflow_tpu.models.inception import InceptionV3  # noqa: F401

@@ -1,0 +1,243 @@
+"""Inception-v3 — the reference's async-PS stress workload (BASELINE.json:10).
+
+The reference trains Inception-v3 on ImageNet with plain per-worker
+``apply_gradients`` against ps-hosted variables — the stale-gradient flavor
+(SURVEY.md §3c). Here the model pairs with the engine's ``mode="stale"``
+deterministic staleness emulator (train/step.py).
+
+Architecture follows the canonical Inception-v3 (Szegedy et al. 2015,
+torchvision layout): BasicConv (conv-BN-relu, no bias, BN eps 1e-3)
+everywhere; stages A(x3) → B → C(x4) → D → E(x2); optional auxiliary
+classifier on the 17x17 grid. ~23.8M params without aux, ~27.2M with.
+
+TPU notes: all branches are 1x1/3x3/5x5/1x7/7x1 convs — MXU-friendly; the
+four branches of each block are independent and XLA schedules them into one
+fused region; concatenation along channels is layout-free in NHWC.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BasicConv(nn.Module):
+    """conv(no bias) + BN(eps=1e-3) + relu — the Inception building block."""
+
+    features: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding=self.padding,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.he_normal(),
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-3,
+            dtype=self.dtype,
+        )(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(BasicConv, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train=train)
+        b5 = conv(48, (1, 1))(x, train=train)
+        b5 = conv(64, (5, 5))(b5, train=train)
+        b3 = conv(64, (1, 1))(x, train=train)
+        b3 = conv(96, (3, 3))(b3, train=train)
+        b3 = conv(96, (3, 3))(b3, train=train)
+        bp = _avg_pool_same(x)
+        bp = conv(self.pool_features, (1, 1))(bp, train=train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35x35 → 17x17 grid reduction."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(BasicConv, dtype=self.dtype)
+        b3 = conv(384, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
+        bd = conv(64, (1, 1))(x, train=train)
+        bd = conv(96, (3, 3))(bd, train=train)
+        bd = conv(96, (3, 3), strides=(2, 2), padding="VALID")(bd, train=train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 blocks with factorized 1x7/7x1 convolutions."""
+
+    channels_7x7: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(BasicConv, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train=train)
+        b7 = conv(c7, (1, 1))(x, train=train)
+        b7 = conv(c7, (1, 7))(b7, train=train)
+        b7 = conv(192, (7, 1))(b7, train=train)
+        bd = conv(c7, (1, 1))(x, train=train)
+        bd = conv(c7, (7, 1))(bd, train=train)
+        bd = conv(c7, (1, 7))(bd, train=train)
+        bd = conv(c7, (7, 1))(bd, train=train)
+        bd = conv(192, (1, 7))(bd, train=train)
+        bp = _avg_pool_same(x)
+        bp = conv(192, (1, 1))(bp, train=train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17x17 → 8x8 grid reduction."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(BasicConv, dtype=self.dtype)
+        b3 = conv(192, (1, 1))(x, train=train)
+        b3 = conv(320, (3, 3), strides=(2, 2), padding="VALID")(b3, train=train)
+        b7 = conv(192, (1, 1))(x, train=train)
+        b7 = conv(192, (1, 7))(b7, train=train)
+        b7 = conv(192, (7, 1))(b7, train=train)
+        b7 = conv(192, (3, 3), strides=(2, 2), padding="VALID")(b7, train=train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 blocks with split 1x3/3x1 branches."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(BasicConv, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train=train)
+        b3 = conv(384, (1, 1))(x, train=train)
+        b3 = jnp.concatenate(
+            [
+                conv(384, (1, 3))(b3, train=train),
+                conv(384, (3, 1))(b3, train=train),
+            ],
+            axis=-1,
+        )
+        bd = conv(448, (1, 1))(x, train=train)
+        bd = conv(384, (3, 3))(bd, train=train)
+        bd = jnp.concatenate(
+            [
+                conv(384, (1, 3))(bd, train=train),
+                conv(384, (3, 1))(bd, train=train),
+            ],
+            axis=-1,
+        )
+        bp = _avg_pool_same(x)
+        bp = conv(192, (1, 1))(bp, train=train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    """Auxiliary classifier over the 17x17x768 grid."""
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        if x.shape[1] < 5 or x.shape[2] < 5:
+            # Below this the VALID 5x5/3 pool produces a zero-size spatial dim
+            # and jnp.mean over it yields silent NaN logits.
+            raise ValueError(
+                f"aux head needs a >=5x5 grid, got {x.shape[1]}x{x.shape[2]} "
+                "(input >=139x139); use aux_logits=False for smaller inputs"
+            )
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = BasicConv(128, (1, 1), dtype=self.dtype)(x, train=train)
+        x = BasicConv(768, (5, 5), padding="VALID", dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+
+
+class InceptionV3(nn.Module):
+    """Inception-v3 over NHWC inputs (299x299 canonical; ≥75x75 with
+    ``aux_logits=False``, ≥139x139 with the aux head — it raises below that).
+
+    When ``aux_logits`` and ``train`` are both true, returns
+    ``(logits, aux_logits)``; otherwise just ``logits`` — mirroring the
+    classic two-head training loss (main + 0.3 * aux).
+    """
+
+    num_classes: int = 1000
+    aux_logits: bool = True
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(BasicConv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(32, (3, 3), strides=(2, 2), padding="VALID")(x, train=train)
+        x = conv(32, (3, 3), padding="VALID")(x, train=train)
+        x = conv(64, (3, 3))(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1))(x, train=train)
+        x = conv(192, (3, 3), padding="VALID")(x, train=train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        x = InceptionA(32, dtype=self.dtype)(x, train=train)
+        x = InceptionA(64, dtype=self.dtype)(x, train=train)
+        x = InceptionA(64, dtype=self.dtype)(x, train=train)
+        x = InceptionB(dtype=self.dtype)(x, train=train)
+        x = InceptionC(128, dtype=self.dtype)(x, train=train)
+        x = InceptionC(160, dtype=self.dtype)(x, train=train)
+        x = InceptionC(160, dtype=self.dtype)(x, train=train)
+        x = InceptionC(192, dtype=self.dtype)(x, train=train)
+
+        aux = None
+        if self.aux_logits:
+            # Parameters must exist regardless of `train` so init(train=False)
+            # and the train step see the same pytree structure.
+            aux_head = InceptionAux(self.num_classes, dtype=self.dtype, name="aux")
+            if train:
+                aux = aux_head(x, train=train)
+            else:
+                _ = aux_head(x, train=False)
+
+        x = InceptionD(dtype=self.dtype)(x, train=train)
+        x = InceptionE(dtype=self.dtype)(x, train=train)
+        x = InceptionE(dtype=self.dtype)(x, train=train)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        if train and aux is not None:
+            return logits, aux
+        return logits

@@ -14,18 +14,29 @@ import jax.numpy as jnp
 import optax
 
 
-def make_classification_loss(model, *, label_smoothing: float = 0.0):
+def make_classification_loss(
+    model, *, label_smoothing: float = 0.0, aux_weight: float = 0.3
+):
     """Return a ``LossFn`` for a flax classifier.
 
     Expects batches ``{"image": [B,H,W,C], "label": [B] int}``. Handles
-    mutable ``batch_stats`` (BN models) and a ``dropout`` rng.
+    mutable ``batch_stats`` (BN models) and a ``dropout`` rng. Models that
+    return ``(logits, aux_logits)`` in train mode (Inception-v3's auxiliary
+    head) contribute ``aux_weight`` x the aux cross-entropy to the loss.
     """
+
+    def ce(logits, labels):
+        onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+        if label_smoothing:
+            n = logits.shape[-1]
+            onehot = onehot * (1.0 - label_smoothing) + label_smoothing / n
+        return optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot).mean()
 
     def loss_fn(params, model_state, batch, rng):
         variables = {"params": params, **model_state}
         mutable = [k for k in model_state if k != "params"]
         if mutable:
-            logits, new_model_state = model.apply(
+            out, new_model_state = model.apply(
                 variables,
                 batch["image"],
                 train=True,
@@ -33,16 +44,15 @@ def make_classification_loss(model, *, label_smoothing: float = 0.0):
                 rngs={"dropout": rng},
             )
         else:
-            logits = model.apply(
+            out = model.apply(
                 variables, batch["image"], train=True, rngs={"dropout": rng}
             )
             new_model_state = model_state
+        logits, aux = out if isinstance(out, tuple) else (out, None)
         labels = batch["label"]
-        onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
-        if label_smoothing:
-            n = logits.shape[-1]
-            onehot = onehot * (1.0 - label_smoothing) + label_smoothing / n
-        loss = optax.softmax_cross_entropy(logits.astype(jnp.float32), onehot).mean()
+        loss = ce(logits, labels)
+        if aux is not None:
+            loss = loss + aux_weight * ce(aux, labels)
         acc = (jnp.argmax(logits, -1) == labels).mean()
         return loss, (new_model_state, {"accuracy": acc})
 
