@@ -1,0 +1,11 @@
+"""Checkpoint/resume: async sharded checkpointing over orbax/tensorstore.
+
+Replaces the reference's ``tf.train.Saver`` + ``MonitoredTrainingSession``
+auto-restore (SURVEY.md §5 checkpoint row): the chief periodically wrote a
+checkpoint; any restarted worker restored the latest. Here saving is
+collective (every host participates, arrays written sharded), asynchronous
+(off the critical path of the step loop — SURVEY.md §7 hard-part 2), and
+restore is just "build the abstract state, load the latest into it".
+"""
+
+from distributed_tensorflow_tpu.ckpt.checkpoint import Checkpointer  # noqa: F401

@@ -1,0 +1,13 @@
+"""CLI: the single pod-level SPMD entrypoint.
+
+The reference needs one ``run_ps.py`` process per ps task plus one
+``run_worker.py`` per worker, each with job-name/task-index/hosts flags
+(SURVEY.md §1 L7, §3a-3b). Under SPMD all of that collapses
+(BASELINE.json:5): every host runs the *same* command —
+
+    python -m distributed_tensorflow_tpu.cli.train --config=<workload>
+
+and topology comes from the slice metadata. No roles, no per-role flags.
+"""
+
+from distributed_tensorflow_tpu.cli.train import PRESETS, WorkloadConfig, main  # noqa: F401

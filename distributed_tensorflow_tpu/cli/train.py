@@ -1,0 +1,336 @@
+"""``python -m distributed_tensorflow_tpu.cli.train --config=<workload>``.
+
+Workload presets mirror the reference's five configurations
+(BASELINE.json "configs" / SURVEY.md §2 workload rows) one-to-one:
+
+=========================  ====================================================
+preset                     reference configuration it rebuilds
+=========================  ====================================================
+``mnist_lenet``            MNIST LeNet-5, single-process sync SGD sanity run
+``cifar_resnet20``         CIFAR-10 ResNet-20, SyncReplicasOptimizer PS (sync DP)
+``imagenet_resnet50``      ImageNet ResNet-50, 8-worker NCCL allreduce (sync DP)
+``imagenet_inception_async`` ImageNet Inception-v3, async PS → stale-K emulation
+``bert_base``              BERT-base pretraining (MLM+NSP), large-embedding DP
+=========================  ====================================================
+
+Every preset runs on any mesh size (DP width comes from the devices present,
+not from the config — there is no worker count to configure away). Datasets
+are seeded synthetic stand-ins with learnable structure (zero-egress
+environment); point ``--data-dir`` at real data when present (data/readers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    """One training workload: model + data + optimization, mesh-agnostic."""
+
+    name: str
+    build: Callable[["WorkloadConfig"], dict[str, Any]]  # returns the pieces
+    global_batch: int
+    num_steps: int
+    learning_rate: float
+    momentum: float = 0.9
+    optimizer: str = "sgd"  # "sgd" | "adam"
+    mode: str = "sync"  # "sync" | "stale"
+    staleness: int = 0
+    seq_parallel: int = 0  # >0: seq axis size for ring attention (BERT)
+    image_size: int = 0  # overridable per run
+    log_every: int = 50
+    ckpt_every: int = 0
+
+
+def _make_tx(cfg: WorkloadConfig) -> optax.GradientTransformation:
+    if cfg.optimizer == "adam":
+        return optax.adam(cfg.learning_rate)
+    if cfg.momentum:
+        return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
+    return optax.sgd(cfg.learning_rate)
+
+
+def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
+    def build(cfg: WorkloadConfig):
+        from distributed_tensorflow_tpu.data import (
+            device_batches,
+            synthetic_image_classification,
+        )
+        from distributed_tensorflow_tpu.train.objectives import (
+            init_model,
+            make_classification_loss,
+        )
+
+        shape = image_shape
+        if cfg.image_size:
+            shape = (cfg.image_size, cfg.image_size, image_shape[-1])
+
+        def make(mesh):
+            params, model_state = init_model(
+                model, jax.random.key(0), jnp.zeros((1, *shape), jnp.float32)
+            )
+            ds = synthetic_image_classification(
+                max(n_examples, cfg.global_batch), shape, num_classes, seed=0
+            )
+            batches = device_batches(ds, mesh, cfg.global_batch, seed=1)
+            return {
+                "params": params,
+                "model_state": model_state,
+                "loss_fn": make_classification_loss(model),
+                "batches": batches,
+                "batch_spec": None,
+            }
+
+        return make
+
+    return build
+
+
+def _build_bert_workload(cfg_kwargs: dict):
+    def build(cfg: WorkloadConfig):
+        from distributed_tensorflow_tpu.data.text import (
+            SyntheticMLM,
+            SyntheticMLMConfig,
+            bert_batch_specs,
+            mlm_device_batches,
+        )
+        from distributed_tensorflow_tpu.models.bert import (
+            BertConfig,
+            BertForPreTraining,
+            make_bert_pretraining_loss,
+        )
+
+        def make(mesh):
+            seq_parallel = cfg.seq_parallel and "seq" in mesh.axis_names
+            init_cfg = BertConfig(**cfg_kwargs)
+            model_cfg = (
+                dataclasses.replace(init_cfg, seq_axis="seq")
+                if seq_parallel
+                else init_cfg
+            )
+            # Init outside shard_map must not bind the seq axis; the param
+            # tree is identical either way (tests/test_bert.py).
+            init_model_ = BertForPreTraining(init_cfg)
+            model = BertForPreTraining(model_cfg)
+            L = init_cfg.max_position
+            variables = init_model_.init(
+                jax.random.key(0),
+                jnp.zeros((1, L), jnp.int32),
+                jnp.ones((1, L), bool),
+                jnp.zeros((1, L), jnp.int32),
+                train=False,
+            )
+            data = SyntheticMLM(
+                SyntheticMLMConfig(
+                    vocab_size=init_cfg.vocab_size, seq_len=L, seed=0
+                )
+            )
+            batches = mlm_device_batches(
+                data, mesh, cfg.global_batch, seq_sharded=bool(seq_parallel), seed=1
+            )
+            return {
+                "params": variables["params"],
+                "model_state": {},
+                "loss_fn": make_bert_pretraining_loss(model),
+                "batches": batches,
+                "batch_spec": bert_batch_specs(
+                    mesh, seq_sharded=bool(seq_parallel)
+                ),
+            }
+
+        return make
+
+    return build
+
+
+def _presets() -> dict[str, WorkloadConfig]:
+    from distributed_tensorflow_tpu.models import (
+        InceptionV3,
+        LeNet5,
+        ResNet20,
+        ResNet50,
+    )
+
+    return {
+        "mnist_lenet": WorkloadConfig(
+            name="mnist_lenet",
+            build=_build_image_workload(LeNet5(), (28, 28, 1), 10),
+            global_batch=128,
+            num_steps=1000,
+            learning_rate=0.05,
+        ),
+        "cifar_resnet20": WorkloadConfig(
+            name="cifar_resnet20",
+            build=_build_image_workload(ResNet20(), (32, 32, 3), 10),
+            global_batch=256,
+            num_steps=2000,
+            learning_rate=0.1,
+        ),
+        "imagenet_resnet50": WorkloadConfig(
+            name="imagenet_resnet50",
+            build=_build_image_workload(
+                ResNet50(dtype=jnp.bfloat16), (224, 224, 3), 1000, n_examples=8192
+            ),
+            global_batch=256,
+            num_steps=5000,
+            learning_rate=0.4,  # linear-scaling rule for large global batch
+        ),
+        "imagenet_inception_async": WorkloadConfig(
+            name="imagenet_inception_async",
+            build=_build_image_workload(
+                InceptionV3(dtype=jnp.bfloat16, aux_logits=False),
+                (299, 299, 3),
+                1000,
+                n_examples=8192,
+            ),
+            global_batch=256,
+            num_steps=5000,
+            learning_rate=0.05,
+            momentum=0.0,
+            mode="stale",
+            staleness=4,
+        ),
+        "bert_base": WorkloadConfig(
+            name="bert_base",
+            build=_build_bert_workload(
+                dict(max_position=128, dropout_rate=0.1, dtype=jnp.bfloat16)
+            ),
+            global_batch=256,
+            num_steps=10000,
+            learning_rate=1e-4,
+            optimizer="adam",
+        ),
+    }
+
+
+PRESETS = _presets()
+
+
+def run(cfg: WorkloadConfig, args: argparse.Namespace):
+    from distributed_tensorflow_tpu.ckpt import Checkpointer
+    from distributed_tensorflow_tpu.obs import make_metric_hook
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        build_mesh,
+        initialize_runtime,
+    )
+    from distributed_tensorflow_tpu.train import (
+        create_train_state,
+        fit,
+        make_train_step,
+    )
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    initialize_runtime()
+    mesh_spec = (
+        {"data": -1, "seq": cfg.seq_parallel} if cfg.seq_parallel else {"data": -1}
+    )
+    mesh = build_mesh(mesh_spec)
+    if jax.process_index() == 0:
+        logging.info("workload=%s mesh=%s", cfg.name, dict(mesh.shape))
+
+    pieces = cfg.build(cfg)(mesh)
+    tx = _make_tx(cfg)
+    state = place_state(
+        create_train_state(
+            pieces["params"],
+            tx,
+            pieces["model_state"],
+            staleness=cfg.staleness if cfg.mode == "stale" else 0,
+        ),
+        mesh,
+    )
+    step = make_train_step(
+        pieces["loss_fn"],
+        tx,
+        mesh,
+        mode=cfg.mode,
+        staleness=cfg.staleness if cfg.mode == "stale" else 0,
+        batch_spec=pieces["batch_spec"],
+    )
+
+    ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
+    start = 0
+    if ckpt is not None:
+        state, start = ckpt.restore_latest(state)
+    hook = make_metric_hook(
+        logdir=args.tb_dir, jsonl=args.metrics_jsonl or None
+    )
+    try:
+        state, last = fit(
+            state,
+            step,
+            pieces["batches"],
+            num_steps=cfg.num_steps,
+            rng=jax.random.key(args.seed),
+            log_every=cfg.log_every,
+            hooks=(hook,),
+            checkpointer=ckpt,
+            ckpt_every=cfg.ckpt_every or args.ckpt_every,
+        )
+        if ckpt is not None and ckpt.latest_step() != int(state.step):
+            ckpt.save(int(state.step), state, force=True)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+        for w in getattr(hook, "writers", ()):
+            w.close()
+    return state, last
+
+
+def main(argv: list[str] | None = None):
+    parser = argparse.ArgumentParser(
+        description="TPU-native distributed training (single SPMD entrypoint)"
+    )
+    parser.add_argument("--config", required=True, choices=sorted(PRESETS))
+    parser.add_argument("--steps", type=int, default=0, help="override num_steps")
+    parser.add_argument("--global-batch", type=int, default=0)
+    parser.add_argument("--image-size", type=int, default=0)
+    parser.add_argument("--seq-parallel", type=int, default=-1,
+                        help="seq axis size for ring attention (BERT)")
+    parser.add_argument("--staleness", type=int, default=-1)
+    parser.add_argument("--log-every", type=int, default=0)
+    parser.add_argument("--ckpt-dir", default="")
+    parser.add_argument("--ckpt-every", type=int, default=0)
+    parser.add_argument("--tb-dir", default="")
+    parser.add_argument("--metrics-jsonl", default="")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s: %(message)s"
+    )
+    cfg = PRESETS[args.config]
+    overrides = {}
+    if args.steps:
+        overrides["num_steps"] = args.steps
+    if args.global_batch:
+        overrides["global_batch"] = args.global_batch
+    if args.image_size:
+        overrides["image_size"] = args.image_size
+    if args.seq_parallel >= 0:
+        overrides["seq_parallel"] = args.seq_parallel
+    if args.staleness >= 0:
+        overrides["staleness"] = args.staleness
+        if args.staleness:
+            overrides["mode"] = "stale"
+    if args.log_every:
+        overrides["log_every"] = args.log_every
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    state, last = run(cfg, args)
+    if jax.process_index() == 0 and last is not None:
+        logging.info("final: %s", last)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,3 +17,9 @@ from distributed_tensorflow_tpu.data.synthetic import (  # noqa: F401
     synthetic_image_classification,
 )
 from distributed_tensorflow_tpu.data.loader import device_batches  # noqa: F401
+from distributed_tensorflow_tpu.data.text import (  # noqa: F401
+    SyntheticMLM,
+    SyntheticMLMConfig,
+    bert_batch_specs,
+    mlm_device_batches,
+)

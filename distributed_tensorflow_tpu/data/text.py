@@ -1,0 +1,161 @@
+"""Synthetic masked-LM pretraining data (zero-egress stand-in for BERT corpora).
+
+Token streams follow a fixed random Markov chain (token_{t+1} =
+perm[token_t] with occasional uniform noise), so MLM is genuinely learnable
+from bidirectional context; sentence pairs either continue the chain
+(NSP label 0, "is next") or jump to an unrelated chain (label 1). BERT-style
+masking: 15% of positions — 80% → [MASK], 10% → random, 10% kept.
+
+Vocab layout: 0=[PAD] 1=[CLS] 2=[SEP] 3=[MASK], content tokens 4..vocab-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+PAD, CLS, SEP, MASK = 0, 1, 2, 3
+NUM_SPECIAL = 4
+
+
+@dataclasses.dataclass
+class SyntheticMLMConfig:
+    vocab_size: int = 1000
+    seq_len: int = 128
+    mask_prob: float = 0.15
+    noise: float = 0.05  # chance a chain step jumps uniformly
+    seed: int = 0
+
+
+class SyntheticMLM:
+    """Generates BERT pretraining batches: ids/mask/types/mlm targets/nsp."""
+
+    def __init__(self, cfg: SyntheticMLMConfig):
+        assert cfg.vocab_size > NUM_SPECIAL + 1
+        self.cfg = cfg
+        rng = np.random.default_rng(cfg.seed)
+        n_content = cfg.vocab_size - NUM_SPECIAL
+        self._perm = rng.permutation(n_content)
+
+    def _chain(self, rng, length: int) -> np.ndarray:
+        n = self.cfg.vocab_size - NUM_SPECIAL
+        out = np.empty(length, np.int64)
+        tok = rng.integers(0, n)
+        for i in range(length):
+            out[i] = tok
+            if rng.random() < self.cfg.noise:
+                tok = rng.integers(0, n)
+            else:
+                tok = self._perm[tok]
+        return out + NUM_SPECIAL
+
+    def batch(self, batch_size: int, *, seed: int) -> dict[str, np.ndarray]:
+        cfg = self.cfg
+        rng = np.random.default_rng((cfg.seed, seed))
+        L = cfg.seq_len
+        # [CLS] a... [SEP] b... [SEP] — split content evenly.
+        n_a = (L - 3) // 2
+        n_b = L - 3 - n_a
+        ids = np.zeros((batch_size, L), np.int32)
+        types = np.zeros((batch_size, L), np.int32)
+        nsp = np.zeros((batch_size,), np.int32)
+        for i in range(batch_size):
+            a = self._chain(rng, n_a + n_b)
+            if rng.random() < 0.5:
+                b = a[n_a:]
+                nsp[i] = 0
+            else:
+                b = self._chain(rng, n_b)
+                nsp[i] = 1
+            row = np.concatenate([[CLS], a[:n_a], [SEP], b[:n_b], [SEP]])
+            ids[i] = row
+            types[i, n_a + 2 :] = 1
+        attention_mask = np.ones((batch_size, L), bool)
+
+        # BERT masking on content positions only.
+        content = ids >= NUM_SPECIAL
+        r = rng.random(ids.shape)
+        selected = content & (r < cfg.mask_prob)
+        targets = np.where(selected, ids, -1).astype(np.int32)
+        action = rng.random(ids.shape)
+        masked_ids = ids.copy()
+        masked_ids[selected & (action < 0.8)] = MASK
+        rand_sites = selected & (action >= 0.8) & (action < 0.9)
+        masked_ids[rand_sites] = rng.integers(
+            NUM_SPECIAL, cfg.vocab_size, size=int(rand_sites.sum())
+        )
+        return {
+            "input_ids": masked_ids,
+            "attention_mask": attention_mask,
+            "token_type_ids": types,
+            "mlm_targets": targets,
+            "nsp_label": nsp,
+        }
+
+
+def bert_batch_specs(mesh, *, seq_sharded: bool = False) -> dict:
+    """Per-leaf PartitionSpecs for a BERT batch (pass as train-step batch_spec).
+
+    [B, L] leaves shard batch over the DP axes and (optionally) sequence over
+    ``"seq"``; the [B] nsp label only shards the batch dim.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.mesh import data_axes
+
+    dp = data_axes(mesh)
+    dp_spec = dp if dp else None
+    seq = "seq" if (seq_sharded and "seq" in mesh.axis_names) else None
+    spec_2d = P(dp_spec, seq)
+    spec_1d = P(dp_spec)
+    return {
+        "input_ids": spec_2d,
+        "attention_mask": spec_2d,
+        "token_type_ids": spec_2d,
+        "mlm_targets": spec_2d,
+        "nsp_label": spec_1d,
+    }
+
+
+def mlm_device_batches(
+    dataset: SyntheticMLM,
+    mesh,
+    global_batch: int,
+    *,
+    seq_sharded: bool = False,
+    seed: int = 0,
+):
+    """Infinite iterator of placed BERT batches.
+
+    ``seq_sharded=True`` additionally shards the [B, L] leaves' second dim
+    over the mesh's ``"seq"`` axis (for ring-attention runs).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu.parallel.mesh import data_axes
+
+    dp = data_axes(mesh)
+    dp_spec = dp if dp else None
+    seq = "seq" if (seq_sharded and "seq" in mesh.axis_names) else None
+    spec_2d = NamedSharding(mesh, P(dp_spec, seq))
+    spec_1d = NamedSharding(mesh, P(dp_spec))
+    n_proc = jax.process_count()
+    proc = jax.process_index()
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    step = 0
+    while True:
+        full = dataset.batch(global_batch, seed=step)
+        local_b = global_batch // n_proc
+        local = {
+            k: v[proc * local_b : (proc + 1) * local_b] for k, v in full.items()
+        }
+        yield {
+            k: jax.make_array_from_process_local_data(
+                spec_1d if v.ndim == 1 else spec_2d, v
+            )
+            for k, v in local.items()
+        }
+        step += 1

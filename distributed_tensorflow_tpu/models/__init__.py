@@ -20,3 +20,10 @@ from distributed_tensorflow_tpu.models.resnet import (  # noqa: F401
     ResNet50,
 )
 from distributed_tensorflow_tpu.models.inception import InceptionV3  # noqa: F401
+from distributed_tensorflow_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    BertForPreTraining,
+    BertModel,
+    bert_base,
+    make_bert_pretraining_loss,
+)

@@ -1,0 +1,271 @@
+"""BERT-base pretraining — the reference's transformer workload (BASELINE.json:11).
+
+The reference pretrains BERT-base data-parallel, stressing the large
+embedding-table allreduce (SURVEY.md §2 workload rows, §7 hard-part 4). This
+rebuild keeps that capability (pure-DP: the 30k-vocab embedding gradient
+rides the same fused psum as everything else) and adds what the TF-1.x
+harness never had: exact sequence/context parallelism — set
+``config.seq_axis`` and the encoder runs ring attention over the ``"seq"``
+mesh axis (parallel/ring_attention.py), with position offsets, pooling, and
+the MLM loss all seq-shard-aware.
+
+Architecture is the original BERT-base (Devlin et al.): post-LayerNorm
+encoder, learned positions, GELU FFN, tied MLM decoder, NSP head.
+12L/768H/12A/3072FF/vocab 30522 ≈ 109.5M params (encoder+embeddings+pooler).
+
+Training objective: masked-LM cross-entropy over masked positions
+(targets < 0 are ignored) + next-sentence-prediction cross-entropy —
+``make_bert_pretraining_loss`` plugs into the standard engine
+(train/step.py), including ``mode="stale"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax import lax
+
+from distributed_tensorflow_tpu.parallel.ring_attention import (
+    dense_attention,
+    ring_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+    # Mesh axis name for sequence parallelism, or None for single-shard
+    # attention. With an axis set, the model must run inside shard_map with
+    # the sequence dim of all [B, L] inputs sharded over that axis.
+    seq_axis: str | None = None
+    # Single-shard attention implementation: "dense" (XLA-composed) or
+    # "flash" (Pallas kernel, ops/flash_attention.py — wins for long L).
+    # Ignored when seq_axis is set (the ring has its own blockwise kernel).
+    attn_impl: str = "dense"
+
+
+def bert_base(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+def _seq_offset(cfg: BertConfig, l_local: int):
+    """Global position of this shard's first token (0 without seq axis)."""
+    if cfg.seq_axis is None:
+        return 0
+    return lax.axis_index(cfg.seq_axis) * l_local
+
+
+class BertEmbeddings(nn.Module):
+    cfg: BertConfig
+
+    def setup(self):
+        cfg = self.cfg
+        init = nn.initializers.normal(0.02)
+        self.word = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, embedding_init=init, dtype=cfg.dtype
+        )
+        self.position = nn.Embed(
+            cfg.max_position, cfg.hidden_size, embedding_init=init, dtype=cfg.dtype
+        )
+        self.token_type = nn.Embed(
+            cfg.type_vocab_size, cfg.hidden_size, embedding_init=init, dtype=cfg.dtype
+        )
+        self.ln = nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype)
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+
+    def __call__(self, input_ids, token_type_ids, *, train: bool = False):
+        l_local = input_ids.shape[1]
+        positions = _seq_offset(self.cfg, l_local) + jnp.arange(l_local)
+        x = (
+            self.word(input_ids)
+            + self.position(positions)[None]
+            + self.token_type(token_type_ids)
+        )
+        return self.dropout(self.ln(x), deterministic=not train)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, *, train: bool = False):
+        cfg = self.cfg
+        b, l, _ = x.shape
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim),
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name=name,
+        )
+        q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        if cfg.seq_axis is not None:
+            ctx = ring_attention(q, k, v, cfg.seq_axis, mask=mask)
+        elif cfg.attn_impl == "flash":
+            from distributed_tensorflow_tpu.ops import flash_attention
+
+            ctx = flash_attention(q, k, v, mask=mask)
+        else:
+            ctx = dense_attention(q, k, v, mask=mask)
+        out = nn.DenseGeneral(
+            cfg.hidden_size,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="out",
+        )(ctx)
+        out = nn.Dropout(cfg.dropout_rate)(out, deterministic=not train)
+        # Post-LN (original BERT): LN over the residual sum.
+        return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="ln")(x + out)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, *, train: bool = False):
+        cfg = self.cfg
+        x = BertSelfAttention(cfg, name="attention")(x, mask, train=train)
+        y = nn.Dense(
+            cfg.intermediate_size,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="intermediate",
+        )(x)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="output",
+        )(y)
+        y = nn.Dropout(cfg.dropout_rate)(y, deterministic=not train)
+        return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="ln")(x + y)
+
+
+class BertModel(nn.Module):
+    """Encoder + pooler. Returns (hidden [B,L,H], pooled [B,H])."""
+
+    cfg: BertConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = [BertLayer(cfg, name=f"layer_{i}") for i in range(cfg.num_layers)]
+        self.pooler = nn.Dense(
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+
+    def __call__(self, input_ids, attention_mask, token_type_ids, *, train=False):
+        cfg = self.cfg
+        x = self.embeddings(input_ids, token_type_ids, train=train)
+        for layer in self.layers:
+            x = layer(x, attention_mask, train=train)
+        first = x[:, 0]
+        if cfg.seq_axis is not None:
+            # The global [CLS] token lives on seq-shard 0: psum-select it so
+            # every shard pools the same vector (grads flow back to shard 0
+            # only, and the engine's seq-psum counts them exactly once).
+            is_first = (lax.axis_index(cfg.seq_axis) == 0).astype(first.dtype)
+            first = lax.psum(first * is_first, cfg.seq_axis)
+        pooled = jnp.tanh(self.pooler(first))
+        return x, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM (tied decoder) + NSP heads over BertModel.
+
+    ``__call__(batch, train) -> (mlm_logits [B,L,V], nsp_logits [B,2])``.
+    """
+
+    cfg: BertConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.bert = BertModel(cfg)
+        self.mlm_transform = nn.Dense(
+            cfg.hidden_size,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        self.mlm_ln = nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype)
+        self.mlm_bias = self.param(
+            "mlm_bias", nn.initializers.zeros_init(), (cfg.vocab_size,)
+        )
+        self.nsp_head = nn.Dense(
+            2, dtype=jnp.float32, kernel_init=nn.initializers.normal(0.02)
+        )
+
+    def __call__(self, input_ids, attention_mask, token_type_ids, *, train=False):
+        hidden, pooled = self.bert(
+            input_ids, attention_mask, token_type_ids, train=train
+        )
+        h = self.mlm_ln(nn.gelu(self.mlm_transform(hidden), approximate=False))
+        # Tied decoder: logits against the word-embedding table.
+        mlm_logits = self.bert.embeddings.word.attend(h) + self.mlm_bias
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits.astype(jnp.float32), nsp_logits.astype(jnp.float32)
+
+
+def make_bert_pretraining_loss(model: BertForPreTraining):
+    """LossFn for the engine: MLM (ignore targets < 0) + NSP.
+
+    Batches: ``input_ids, attention_mask, token_type_ids, mlm_targets`` all
+    ``[B, L]`` (sharded over "seq" when seq-parallel) and ``nsp_label [B]``.
+    With ``cfg.seq_axis`` set, the MLM numerator/denominator are psum'd over
+    the seq ring so every shard returns the *global* loss — required by the
+    engine's seq-grad contract (train/step.py).
+    """
+    seq_axis = model.cfg.seq_axis
+
+    def loss_fn(params, model_state, batch, rng):
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch["attention_mask"],
+            batch["token_type_ids"],
+            train=True,
+            rngs={"dropout": rng},
+        )
+        targets = batch["mlm_targets"]
+        weights = (targets >= 0).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            mlm_logits, jnp.maximum(targets, 0)
+        )
+        num = jnp.sum(ce * weights)
+        den = jnp.sum(weights)
+        correct = jnp.sum(
+            (jnp.argmax(mlm_logits, -1) == targets).astype(jnp.float32) * weights
+        )
+        if seq_axis is not None:
+            num = lax.psum(num, seq_axis)
+            den = lax.psum(den, seq_axis)
+            correct = lax.psum(correct, seq_axis)
+        den = jnp.maximum(den, 1.0)
+        mlm_loss = num / den
+        nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
+            nsp_logits, batch["nsp_label"]
+        ).mean()
+        loss = mlm_loss + nsp_loss
+        metrics = {
+            "mlm_loss": mlm_loss,
+            "nsp_loss": nsp_loss,
+            "mlm_accuracy": correct / den,
+        }
+        return loss, (model_state, metrics)
+
+    return loss_fn

@@ -172,12 +172,13 @@ class InceptionAux(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        if x.shape[1] < 5 or x.shape[2] < 5:
-            # Below this the VALID 5x5/3 pool produces a zero-size spatial dim
-            # and jnp.mean over it yields silent NaN logits.
+        if x.shape[1] < 17 or x.shape[2] < 17:
+            # The 5x5/3 pool then the VALID 5x5 conv need a >=17x17 grid
+            # (((17-5)//3)+1 == 5); anything smaller collapses to a zero-size
+            # spatial dim and jnp.mean over it yields silent NaN logits.
             raise ValueError(
-                f"aux head needs a >=5x5 grid, got {x.shape[1]}x{x.shape[2]} "
-                "(input >=139x139); use aux_logits=False for smaller inputs"
+                f"aux head needs a >=17x17 grid, got {x.shape[1]}x{x.shape[2]} "
+                "(input >=299x299); use aux_logits=False for smaller inputs"
             )
         x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
         x = BasicConv(128, (1, 1), dtype=self.dtype)(x, train=train)
@@ -188,7 +189,8 @@ class InceptionAux(nn.Module):
 
 class InceptionV3(nn.Module):
     """Inception-v3 over NHWC inputs (299x299 canonical; ≥75x75 with
-    ``aux_logits=False``, ≥139x139 with the aux head — it raises below that).
+    ``aux_logits=False``; the aux head needs the full 299x299 train-time
+    input — it raises below a 17x17 aux grid).
 
     When ``aux_logits`` and ``train`` are both true, returns
     ``(logits, aux_logits)``; otherwise just ``logits`` — mirroring the
@@ -222,14 +224,12 @@ class InceptionV3(nn.Module):
         x = InceptionC(192, dtype=self.dtype)(x, train=train)
 
         aux = None
-        if self.aux_logits:
-            # Parameters must exist regardless of `train` so init(train=False)
-            # and the train step see the same pytree structure.
+        if self.aux_logits and (train or self.is_initializing()):
+            # Runs during init (so the param tree is stable regardless of
+            # `train`) and in training; skipped entirely in eval, where the
+            # head is dead code — eval also works below the aux size guard.
             aux_head = InceptionAux(self.num_classes, dtype=self.dtype, name="aux")
-            if train:
-                aux = aux_head(x, train=train)
-            else:
-                _ = aux_head(x, train=False)
+            aux = aux_head(x, train=train)
 
         x = InceptionD(dtype=self.dtype)(x, train=train)
         x = InceptionE(dtype=self.dtype)(x, train=train)

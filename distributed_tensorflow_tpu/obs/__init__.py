@@ -1,0 +1,16 @@
+"""Observability: metric writers and profiling.
+
+Replaces the reference's ``tf.summary`` scalars + ``SummarySaverHook`` +
+Chrome-timeline ``RunOptions`` tracing (SURVEY.md §5 metrics/tracing rows):
+metrics are device-computed scalars fetched at the logging cadence (never
+per step — no host sync in the hot loop), written to TensorBoard and/or
+JSONL by process 0; profiling is ``jax.profiler`` traces viewable in
+TensorBoard's profile plugin (xprof).
+"""
+
+from distributed_tensorflow_tpu.obs.metrics import (  # noqa: F401
+    JsonlWriter,
+    TensorBoardWriter,
+    make_metric_hook,
+)
+from distributed_tensorflow_tpu.obs.profile import trace_steps  # noqa: F401

@@ -1,0 +1,74 @@
+"""Metric writers: TensorBoard scalars and append-only JSONL.
+
+Only process 0 writes (the reference gated summaries on the chief the same
+way, SURVEY.md §5); other hosts get no-op hooks, so call sites stay
+branch-free.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import jax
+
+
+class JsonlWriter:
+    """One JSON object per log event: ``{"step": n, "wall": t, ...metrics}``."""
+
+    def __init__(self, path: str | Path):
+        self._path = Path(path)
+        self._path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = self._path.open("a")
+
+    def write(self, step: int, metrics: dict) -> None:
+        rec = {"step": step, "wall": time.time(), **metrics}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class TensorBoardWriter:
+    """Scalar writer over flax's TensorBoard summary backend."""
+
+    def __init__(self, logdir: str | Path):
+        from flax.metrics import tensorboard
+
+        self._sw = tensorboard.SummaryWriter(str(logdir))
+
+    def write(self, step: int, metrics: dict) -> None:
+        for k, v in metrics.items():
+            self._sw.scalar(k, v, step)
+        self._sw.flush()
+
+    def close(self) -> None:
+        self._sw.close()
+
+
+def make_metric_hook(
+    logdir: str | Path | None = None,
+    jsonl: str | Path | None = None,
+):
+    """Build a ``fit()`` hook writing to TensorBoard and/or JSONL.
+
+    Process 0 only; returns a no-op hook elsewhere. The hook signature is
+    the loop's: ``hook(step, state, metrics)``.
+    """
+    if jax.process_index() != 0 or (logdir is None and jsonl is None):
+        return lambda step, state, metrics: None
+    writers = []
+    if logdir is not None:
+        writers.append(TensorBoardWriter(logdir))
+    if jsonl is not None:
+        writers.append(JsonlWriter(jsonl))
+
+    def hook(step: int, state, metrics: dict) -> None:
+        del state
+        for w in writers:
+            w.write(step, metrics)
+
+    hook.writers = writers  # exposed so callers/tests can close them
+    return hook

@@ -1,0 +1,30 @@
+"""Profiling: xprof traces of the compiled step (SURVEY.md §5 tracing row).
+
+The reference's per-``sess.run`` ``RunOptions(trace_level=FULL_TRACE)``
+Chrome timeline becomes a ``jax.profiler`` trace window around N steps,
+viewable with TensorBoard's profile plugin — including per-op TPU timing,
+HBM usage, and the ICI collectives the step issues.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_steps(logdir: str | Path):
+    """Context manager: profile everything dispatched inside the window.
+
+    Usage::
+
+        with trace_steps("/tmp/xprof"):
+            for _ in range(5):
+                state, m = train_step(state, next(batches), rng)
+            jax.block_until_ready(state.params)
+    """
+    Path(logdir).mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(logdir)):
+        yield

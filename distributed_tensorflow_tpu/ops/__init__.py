@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's "native layer" is the TF-1.x CUDA runtime it drives
+(SURVEY.md §2 native-component table); in this rebuild the sanctioned native
+compute layer on TPU is Pallas. Kernels here are drop-in replacements for
+their XLA-composed equivalents, exact to f32-accumulation tolerance, with
+``interpret=True`` fallbacks so every kernel is CI-testable on CPU.
+"""
+
+from distributed_tensorflow_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,288 @@
+"""Flash attention as a Pallas TPU kernel (forward + custom-VJP backward).
+
+The hot op of the BERT workload (SURVEY.md §7 step 8: "Pallas kernels ...
+attention for BERT if MFU < target"). Blockwise online-softmax attention:
+O(L) memory instead of materializing the [L, L] score matrix in HBM, with
+the K/V stream resident in VMEM and every matmul on the MXU.
+
+Semantics match ``parallel.ring_attention.dense_attention`` exactly (same
+layout ``[B, L, H, D]``, same key-padding-mask contract, f32 accumulation) —
+the equivalence test in tests/test_flash_attention.py pins it. Composable
+with the ring: ring attention's per-block compute can use this kernel as its
+inner step (ring = outer loop over ICI, flash = inner loop over VMEM).
+
+Kernel structure (one (batch, head, q-block) program per grid point):
+  fwd:  stream K/V blocks from VMEM, online softmax, save per-row logsumexp
+  bwd:  dQ pass gridded over q-blocks; dK/dV pass gridded over k-blocks;
+        both recompute P from the saved logsumexp (no [L,L] residual)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+_DEFAULT_BLOCK = 128
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k, scale):
+    # q_ref: [BQ, D]; k_ref/v_ref: [L, D]; mask_ref: [1, L]; o: [BQ, D];
+    # lse: [1, BQ]. One program per (b*h, q-block).
+    bq, d = q_ref.shape
+    l = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    def body(j, carry):
+        o, m, denom = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
+        s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = p * mask_blk[None, :]
+        corr = jnp.exp(m - m_new)
+        denom = denom * corr + jnp.sum(p, axis=-1)
+        o = o * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o, m_new, denom
+
+    o = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq,), _NEG, jnp.float32)
+    denom = jnp.zeros((bq,), jnp.float32)
+    o, m, denom = jax.lax.fori_loop(0, l // block_k, body, (o, m, denom))
+    safe = jnp.maximum(denom, 1e-37)
+    o_ref[:] = (o / safe[:, None]).astype(o_ref.dtype)
+    # logsumexp per query row; fully-masked rows get _NEG (o stays 0).
+    lse_ref[0, :] = jnp.where(denom > 0, m + jnp.log(safe), _NEG)
+
+
+def _fwd(q, k, v, mask, block_q, block_k, interpret):
+    bh, l, d = q.shape
+    scale = d**-0.5
+    grid = (bh, l // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, l, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, l, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, l, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, l), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask)
+    return o, lse.reshape(bh, l)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, scale
+):
+    bq, d = q_ref.shape
+    l = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[0, :]
+    delta = delta_ref[0, :]
+
+    def body(j, dq):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
+        p = jnp.exp(s - lse[:, None]) * mask_blk[None, :]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jnp.zeros((bq, d), jnp.float32)
+    dq = jax.lax.fori_loop(0, l // block_k, body, dq)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, scale,
+):
+    bk, d = k_ref.shape
+    l = q_ref.shape[0]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    j = pl.program_id(1)
+    mask_blk = mask_ref[0, pl.ds(j * bk, bk)]
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = scale * jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
+        p = jnp.exp(s - lse_blk[:, None]) * mask_blk[None, :]
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, l // block_q, body, (dk, dv))
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(block_q, block_k, interpret, residuals, g):
+    q, k, v, mask, o, lse = residuals
+    do = g
+    bh, l, d = q.shape
+    scale = d**-0.5
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh,l]
+    delta = delta.reshape(bh, 1, l)
+    lse3 = lse.reshape(bh, 1, l)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale),
+        grid=(bh, l // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, l, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, l, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((None, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, l, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, mask, do, lse3, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, scale=scale),
+        grid=(bh, l // block_k),
+        in_specs=[
+            pl.BlockSpec((None, l, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, l, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, l, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, l, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask, do, lse3, delta)
+    return dq, dk, dv, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, mask, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, mask, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, mask, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, mask, block_q, block_k, interpret)
+    return o, (q, k, v, mask, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    block_q: int = _DEFAULT_BLOCK,
+    block_k: int = _DEFAULT_BLOCK,
+    interpret: bool | None = None,
+):
+    """Exact attention, flash-style. Layout ``[B, L, H, D]``, mask ``[B, L]``.
+
+    Pads L up to a block multiple internally (padded keys masked out, padded
+    query rows sliced off). ``interpret=None`` auto-selects interpreter mode
+    off-TPU so tests run on CPU.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    b, l, h, d = q.shape
+    block_q = min(block_q, max(l, 8))
+    block_k = min(block_k, max(l, 8))
+    l_pad = -(-l // max(block_q, block_k)) * max(block_q, block_k)
+    if mask is None:
+        mask = jnp.ones((b, l), bool)
+    if l_pad != l:
+        pad = ((0, 0), (0, l_pad - l), (0, 0), (0, 0))
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        mask = jnp.pad(mask, ((0, 0), (0, l_pad - l)))
+
+    # [B, L, H, D] -> [B*H, L, D]
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l_pad, d)
+
+    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
+    mask_bh = jnp.repeat(mask.astype(jnp.float32), h, axis=0).reshape(
+        b * h, 1, l_pad
+    )
+    o = _flash(qh, kh, vh, mask_bh, block_q, block_k, interpret)
+    o = o.reshape(b, h, l_pad, d).transpose(0, 2, 1, 3)
+    return o[:, :l]

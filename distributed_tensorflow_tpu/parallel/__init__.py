@@ -13,3 +13,7 @@ from distributed_tensorflow_tpu.parallel.mesh import (  # noqa: F401
     initialize_runtime,
 )
 from distributed_tensorflow_tpu.parallel import collectives  # noqa: F401
+from distributed_tensorflow_tpu.parallel.ring_attention import (  # noqa: F401
+    dense_attention,
+    ring_attention,
+)

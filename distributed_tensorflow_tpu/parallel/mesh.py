@@ -136,22 +136,35 @@ def initialize_runtime(
     _runtime_initialized = True
 
 
-# Environment markers that indicate this process is part of a multi-host
-# cluster; if any is set, an init failure is a real error, not a fallback.
-_CLUSTER_ENV_VARS = (
-    "COORDINATOR_ADDRESS",
-    "MEGASCALE_COORDINATOR_ADDRESS",
-    "TPU_WORKER_HOSTNAMES",
-    "TPU_WORKER_ID",
-    "SLURM_JOB_NUM_NODES",
-    "OMPI_COMM_WORLD_SIZE",
-)
-
-
 def _cluster_env_present() -> bool:
+    """True only for genuinely multi-host environment markers.
+
+    Single-host TPU VMs (and tunneled dev environments) legitimately set
+    ``TPU_WORKER_HOSTNAMES=localhost`` — a one-entry host list is not a
+    cluster, and an init failure there must fall back to single-process.
+    """
     import os
 
-    return any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)
+    env = os.environ.get
+    if env("COORDINATOR_ADDRESS") or env("MEGASCALE_COORDINATOR_ADDRESS"):
+        return True
+    hostnames = env("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) > 1:
+        return True
+    try:
+        # A nonzero worker id means this process is not the only worker even
+        # if the launcher didn't propagate the full host list.
+        if int(env("TPU_WORKER_ID", "0")) > 0:
+            return True
+    except ValueError:
+        pass
+    for count_var in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(env(count_var, "0")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def build_mesh(

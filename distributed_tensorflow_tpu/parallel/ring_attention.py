@@ -1,0 +1,109 @@
+"""Ring attention: exact sequence/context-parallel attention over an ICI ring.
+
+The reference has no long-context machinery (SURVEY.md §2 parallelism
+inventory: SP/CP "absent — 2017-era TF-1.x harness"); this module is the
+framework's first-class TPU-native answer (SURVEY.md §5 long-context row):
+shard the sequence over a ``"seq"`` mesh axis and rotate key/value blocks
+around the ring with ``lax.ppermute`` — on a TPU torus each hop is a pure
+ICI-neighbor transfer that overlaps with the attention block compute.
+
+The math is blockwise (flash-style) online softmax, so the result is *exact*
+full attention, not an approximation: each device holds its query shard and
+accumulates ``softmax(QK^T)V`` over all key blocks as they stream past,
+carrying running max/denominator in f32.
+
+Must run inside a context binding the seq axis (``shard_map`` — the train
+step already provides one). Layout: ``[batch, seq_local, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Finite mask value: -inf would turn exp(-inf + inf) into NaN for
+# fully-masked rows; exp(-1e30 - m) == 0 exactly in f32 for any finite m.
+_MASK_VALUE = -1e30
+
+
+def dense_attention(q, k, v, mask=None):
+    """Reference single-device attention, same layout/mask contract.
+
+    ``q,k,v: [B, L, H, D]``; ``mask: [B, Lk]`` True = attend (key padding
+    mask). Accumulates in f32, returns q.dtype.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("blhd,bkhd->bhlk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :], s, _MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhlk,bkhd->blhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str, mask=None):
+    """Exact attention with Q sharded and K/V streamed around ``axis_name``.
+
+    Args:
+      q, k, v: local shards ``[B, L_local, H, D]`` (global L = L_local * ring
+        size; every device holds the same B).
+      axis_name: bound mesh axis to ring over (e.g. ``"seq"``).
+      mask: local key-padding mask ``[B, L_local]``, True = attend; rotates
+        around the ring alongside K/V.
+
+    Returns:
+      ``[B, L_local, H, D]`` — this device's query shard attended over the
+      *global* sequence, bit-comparable to :func:`dense_attention` on the
+      gathered arrays (up to f32 reduction order).
+    """
+    n = lax.axis_size(axis_name)
+    scale = q.shape[-1] ** -0.5
+    b, l_q, h, d = q.shape
+
+    q32 = q.astype(jnp.float32)
+    o = jnp.zeros((b, l_q, h, d), jnp.float32)
+    m = jnp.full((b, h, l_q), _MASK_VALUE, jnp.float32)
+    denom = jnp.zeros((b, h, l_q), jnp.float32)
+
+    def one_block(carry, _):
+        k_blk, v_blk, mask_blk, o, m, denom = carry
+        s = (
+            jnp.einsum(
+                "blhd,bkhd->bhlk", q32, k_blk, preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if mask_blk is not None:
+            s = jnp.where(mask_blk[:, None, None, :], s, _MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if mask_blk is not None:
+            # When every key so far is masked, m_new == _MASK_VALUE and
+            # exp(s - m_new) == 1 for masked entries — zero them explicitly.
+            p = p * mask_blk[:, None, None, :]
+        corr = jnp.exp(m - m_new)
+        denom = denom * corr + p.sum(axis=-1)
+        o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhlk,bkhd->blhd",
+            p,
+            v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # Stream the next block in: one ICI-neighbor hop, overlapped by XLA
+        # with the block compute above (the whole point of the ring layout).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        if mask_blk is not None:
+            mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return (k_blk, v_blk, mask_blk, o, m_new, denom), None
+
+    carry = (k.astype(jnp.float32), v.astype(jnp.float32), mask, o, m, denom)
+    carry, _ = lax.scan(one_block, carry, None, length=n)
+    _, _, _, o, m, denom = carry
+    # A row with zero attendable keys ends with denom 0 — define output 0.
+    safe = jnp.maximum(denom, 1e-37)
+    return (o / safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)

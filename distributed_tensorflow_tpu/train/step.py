@@ -107,6 +107,18 @@ def make_train_step(
         metrics = dict(metrics)
         metrics["loss"] = loss
 
+        if "seq" in mesh.axis_names:
+            # Sequence-parallel contract: the loss_fn must return the
+            # *global* scalar on every seq shard (psum its numerator/
+            # denominator over "seq" — see models/bert.py). Under shard_map
+            # without replication tracking (check_vma=False), psum transposes
+            # to psum, so each shard's backward already carries the global
+            # cotangent and every param-grad path picks up exactly one factor
+            # of the ring size — whether the path crosses a loss psum
+            # (partitioned compute) or is shard-replicated (post-psum heads).
+            # pmean removes that uniform factor exactly; verified against the
+            # dense model in tests/test_bert.py.
+            grads = coll.pmean_tree(grads, "seq")
         if dp_axes:
             # THE sync point: one fused AllReduce over ICI replaces the
             # reference's entire ps round-trip / NCCL ring (SURVEY.md §3b/3d).

@@ -1,0 +1,150 @@
+"""Checkpoint/resume tests: the reference's restart-recovery capability
+(SURVEY.md §5) — save during training, kill, restore, continue identically."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_tensorflow_tpu.ckpt import Checkpointer
+from distributed_tensorflow_tpu.data import (
+    device_batches,
+    synthetic_image_classification,
+)
+from distributed_tensorflow_tpu.models import LeNet5
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_tpu.train import create_train_state, fit, make_train_step
+from distributed_tensorflow_tpu.train.objectives import (
+    init_model,
+    make_classification_loss,
+)
+from distributed_tensorflow_tpu.train.step import place_state
+
+
+def _setup(mesh, staleness=0):
+    model = LeNet5()
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((2, 28, 28, 1))
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    state = place_state(
+        create_train_state(params, tx, model_state, staleness=staleness), mesh
+    )
+    step = make_train_step(
+        make_classification_loss(model),
+        tx,
+        mesh,
+        mode="stale" if staleness else "sync",
+        staleness=staleness,
+    )
+    return state, step
+
+
+def test_save_restore_roundtrip(tmp_path, data_mesh):
+    state, step = _setup(data_mesh)
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+    batches = device_batches(ds, data_mesh, global_batch=64, seed=1)
+    rng = jax.random.key(0)
+    for _ in range(3):
+        state, _ = step(state, next(batches), rng)
+
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        ckpt.save(3, state)
+        ckpt.wait()
+        assert ckpt.latest_step() == 3
+        fresh, _ = _setup(data_mesh)
+        restored, start = ckpt.restore_latest(fresh)
+
+    assert start == 3
+    assert int(restored.step) == 3
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(restored.params),
+        jax.device_get(state.params),
+    )
+    # Optimizer slots (momentum) restored too.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(restored.opt_state),
+        jax.device_get(state.opt_state),
+    )
+
+
+def test_resume_continues_identically(tmp_path, data_mesh):
+    """Train 6 straight vs train 3 + checkpoint + restore + train 3."""
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=2)
+    rng = jax.random.key(1)
+
+    # Straight run: 6 steps.
+    state_a, step = _setup(data_mesh)
+    batches = device_batches(ds, data_mesh, global_batch=64, seed=3)
+    for _ in range(6):
+        state_a, _ = step(state_a, next(batches), rng)
+
+    # Interrupted run: 3 steps, save, "crash", restore, 3 more steps with a
+    # data iterator resumed at the same position.
+    state_b, _ = _setup(data_mesh)
+    batches_b = device_batches(ds, data_mesh, global_batch=64, seed=3)
+    for _ in range(3):
+        state_b, _ = step(state_b, next(batches_b), rng)
+    with Checkpointer(tmp_path / "c2") as ckpt:
+        ckpt.save(3, state_b)
+        ckpt.wait()
+        fresh, _ = _setup(data_mesh)
+        state_c, start = ckpt.restore_latest(fresh)
+    assert start == 3
+    batches_c = device_batches(ds, data_mesh, global_batch=64, seed=3)
+    for i in range(6):
+        b = next(batches_c)
+        if i >= 3:
+            state_c, _ = step(state_c, b, rng)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        jax.device_get(state_a.params),
+        jax.device_get(state_c.params),
+    )
+
+
+def test_stale_buffer_roundtrips(tmp_path, data_mesh):
+    """The async-stale grad ring buffer survives checkpoint/restore."""
+    state, step = _setup(data_mesh, staleness=2)
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=4)
+    batches = device_batches(ds, data_mesh, global_batch=64, seed=5)
+    rng = jax.random.key(2)
+    for _ in range(3):
+        state, _ = step(state, next(batches), rng)
+    with Checkpointer(tmp_path / "c3") as ckpt:
+        ckpt.save(3, state)
+        ckpt.wait()
+        fresh, _ = _setup(data_mesh, staleness=2)
+        restored, _ = ckpt.restore_latest(fresh)
+    assert int(restored.buffer_index) == int(state.buffer_index)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(restored.grad_buffer),
+        jax.device_get(state.grad_buffer),
+    )
+
+
+def test_fit_periodic_checkpointing(tmp_path, data_mesh):
+    """The fit() loop's ckpt_every hook — analog of the chief's periodic
+    Saver writes (SURVEY.md §5)."""
+    state, step = _setup(data_mesh)
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=6)
+    batches = device_batches(ds, data_mesh, global_batch=64, seed=7)
+    with Checkpointer(tmp_path / "c4", max_to_keep=2) as ckpt:
+        fit(
+            state,
+            step,
+            batches,
+            num_steps=10,
+            rng=jax.random.key(0),
+            log_every=0,
+            checkpointer=ckpt,
+            ckpt_every=4,
+        )
+        ckpt.wait()
+        assert ckpt.latest_step() == 8

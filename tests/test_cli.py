@@ -1,0 +1,116 @@
+"""CLI tests: every preset trains end-to-end through the real entrypoint."""
+
+import json
+
+import pytest
+
+from distributed_tensorflow_tpu.cli import PRESETS, main
+
+
+def test_presets_cover_reference_configs():
+    """The five reference configs (BASELINE.json) map 1:1 onto presets."""
+    assert set(PRESETS) == {
+        "mnist_lenet",
+        "cifar_resnet20",
+        "imagenet_resnet50",
+        "imagenet_inception_async",
+        "bert_base",
+    }
+    assert PRESETS["imagenet_inception_async"].mode == "stale"
+    assert PRESETS["imagenet_inception_async"].staleness > 0
+
+
+def test_cli_mnist_end_to_end(tmp_path):
+    rc = main(
+        [
+            "--config=mnist_lenet",
+            "--steps=6",
+            "--global-batch=32",
+            "--log-every=3",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert lines and lines[-1]["step"] == 6
+    assert "loss" in lines[-1]
+
+
+def test_cli_resume_from_checkpoint(tmp_path):
+    args = [
+        "--config=mnist_lenet",
+        "--steps=4",
+        "--global-batch=32",
+        "--log-every=2",
+        f"--ckpt-dir={tmp_path}/ck",
+        f"--metrics-jsonl={tmp_path}/m.jsonl",
+    ]
+    assert main(args) == 0
+    # Second invocation restores step 4 and is already done.
+    assert main(args) == 0
+    steps = [
+        json.loads(x)["step"] for x in (tmp_path / "m.jsonl").read_text().splitlines()
+    ]
+    assert steps[-1] == 4
+
+
+def test_cli_resnet20_small(tmp_path):
+    rc = main(
+        [
+            "--config=cifar_resnet20",
+            "--steps=3",
+            "--global-batch=16",
+            "--log-every=3",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_cli_inception_stale_small(tmp_path):
+    rc = main(
+        [
+            "--config=imagenet_inception_async",
+            "--steps=3",
+            "--global-batch=8",
+            "--image-size=75",
+            "--log-every=3",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    rec = json.loads((tmp_path / "m.jsonl").read_text().splitlines()[-1])
+    assert rec["staleness"] == 4.0
+
+
+@pytest.mark.slow
+def test_cli_resnet50_small(tmp_path):
+    rc = main(
+        [
+            "--config=imagenet_resnet50",
+            "--steps=2",
+            "--global-batch=8",
+            "--image-size=64",
+            "--log-every=2",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_cli_bert_seq_parallel(tmp_path):
+    rc = main(
+        [
+            "--config=bert_base",
+            "--steps=2",
+            "--global-batch=8",
+            "--seq-parallel=4",
+            "--log-every=2",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    rec = json.loads((tmp_path / "m.jsonl").read_text().splitlines()[-1])
+    assert "mlm_loss" in rec

@@ -1,0 +1,41 @@
+"""Observability tests: metric hooks write; profiler produces a trace."""
+
+import json
+
+import jax
+
+from distributed_tensorflow_tpu.obs import JsonlWriter, make_metric_hook, trace_steps
+
+
+def test_jsonl_writer(tmp_path):
+    w = JsonlWriter(tmp_path / "m.jsonl")
+    w.write(10, {"loss": 1.5})
+    w.write(20, {"loss": 1.0, "acc": 0.5})
+    w.close()
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert [x["step"] for x in lines] == [10, 20]
+    assert lines[1]["acc"] == 0.5
+    assert all("wall" in x for x in lines)
+
+
+def test_metric_hook_tensorboard(tmp_path):
+    hook = make_metric_hook(logdir=tmp_path / "tb", jsonl=tmp_path / "m.jsonl")
+    hook(5, None, {"loss": 2.0})
+    for w in hook.writers:
+        w.close()
+    # TB event file exists and is non-trivial.
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+    assert (tmp_path / "m.jsonl").exists()
+
+
+def test_noop_hook_without_sinks():
+    hook = make_metric_hook()
+    hook(1, None, {"loss": 0.0})  # must not raise
+
+
+def test_trace_steps_writes_profile(tmp_path):
+    with trace_steps(tmp_path / "prof"):
+        jax.block_until_ready(jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))
+    produced = list((tmp_path / "prof").rglob("*"))
+    assert any(p.is_file() for p in produced), produced
