@@ -47,6 +47,8 @@ class WorkloadConfig:
     staleness: int = 0
     seq_parallel: int = 0  # >0: seq axis size for ring attention (BERT)
     image_size: int = 0  # overridable per run
+    dataset: str = ""  # real-dataset name for data/readers.load_dataset
+    data_dir: str = ""  # where to look for it; synthetic fallback otherwise
     log_every: int = 50
     ckpt_every: int = 0
 
@@ -61,10 +63,8 @@ def _make_tx(cfg: WorkloadConfig) -> optax.GradientTransformation:
 
 def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
     def build(cfg: WorkloadConfig):
-        from distributed_tensorflow_tpu.data import (
-            device_batches,
-            synthetic_image_classification,
-        )
+        from distributed_tensorflow_tpu.data import device_batches
+        from distributed_tensorflow_tpu.data.readers import load_dataset
         from distributed_tensorflow_tpu.train.objectives import (
             init_model,
             make_classification_loss,
@@ -78,9 +78,20 @@ def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
             params, model_state = init_model(
                 model, jax.random.key(0), jnp.zeros((1, *shape), jnp.float32)
             )
-            ds = synthetic_image_classification(
-                max(n_examples, cfg.global_batch), shape, num_classes, seed=0
+            ds = load_dataset(
+                cfg.dataset or "synthetic",
+                cfg.data_dir or None,
+                fallback_examples=max(n_examples, cfg.global_batch),
+                image_shape=shape,
+                num_classes=num_classes,
+                seed=0,
             )
+            if tuple(ds.images.shape[1:]) != tuple(shape):
+                raise ValueError(
+                    f"dataset images are {ds.images.shape[1:]} but the model "
+                    f"was configured for {shape} (--image-size conflicts with "
+                    "the real dataset's geometry)"
+                )
             batches = device_batches(ds, mesh, cfg.global_batch, seed=1)
             return {
                 "params": params,
@@ -167,6 +178,7 @@ def _presets() -> dict[str, WorkloadConfig]:
             global_batch=128,
             num_steps=1000,
             learning_rate=0.05,
+            dataset="mnist",
         ),
         "cifar_resnet20": WorkloadConfig(
             name="cifar_resnet20",
@@ -174,6 +186,7 @@ def _presets() -> dict[str, WorkloadConfig]:
             global_batch=256,
             num_steps=2000,
             learning_rate=0.1,
+            dataset="cifar10",
         ),
         "imagenet_resnet50": WorkloadConfig(
             name="imagenet_resnet50",
@@ -298,6 +311,8 @@ def main(argv: list[str] | None = None):
                         help="seq axis size for ring attention (BERT)")
     parser.add_argument("--staleness", type=int, default=-1)
     parser.add_argument("--log-every", type=int, default=0)
+    parser.add_argument("--data-dir", default="",
+                        help="directory with real dataset files (synthetic fallback)")
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--ckpt-every", type=int, default=0)
     parser.add_argument("--tb-dir", default="")
@@ -324,6 +339,8 @@ def main(argv: list[str] | None = None):
             overrides["mode"] = "stale"
     if args.log_every:
         overrides["log_every"] = args.log_every
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     state, last = run(cfg, args)

@@ -19,6 +19,24 @@ from distributed_tensorflow_tpu.data.synthetic import SyntheticClassification
 from distributed_tensorflow_tpu.parallel.mesh import batch_pspec, data_axes
 
 
+def _global_batch_layout(mesh, global_batch: int):
+    """Shared validation + sharding for global-batch producers.
+
+    Returns ``(sharding, process_index, local_batch)`` after checking the
+    global batch divides both the DP world size and the host count.
+    """
+    n_dp = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
+    if global_batch % n_dp:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by DP world size {n_dp}"
+        )
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    sharding = NamedSharding(mesh, batch_pspec(mesh))
+    return sharding, jax.process_index(), global_batch // n_proc
+
+
 def device_batches(
     dataset: SyntheticClassification,
     mesh,
@@ -38,17 +56,7 @@ def device_batches(
     n = len(dataset)
     if global_batch > n:
         raise ValueError(f"global batch {global_batch} > dataset size {n}")
-    n_dp = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
-    if global_batch % n_dp:
-        raise ValueError(
-            f"global batch {global_batch} not divisible by DP world size {n_dp}"
-        )
-    sharding = NamedSharding(mesh, batch_pspec(mesh))
-    n_proc = jax.process_count()
-    proc = jax.process_index()
-    if global_batch % n_proc:
-        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
-    local_b = global_batch // n_proc
+    sharding, proc, local_b = _global_batch_layout(mesh, global_batch)
     epoch = 0
     while True:
         order = np.random.default_rng(seed + epoch).permutation(n)
@@ -63,3 +71,45 @@ def device_batches(
                 for k, v in local.items()
             }
         epoch += 1
+
+
+def native_device_batches(
+    dataset: SyntheticClassification,
+    mesh,
+    global_batch: int,
+    *,
+    pad: int = 0,
+    flip: bool = False,
+    standardize: bool = False,
+    seed: int = 0,
+    n_threads: int = 4,
+) -> Iterator[dict]:
+    """Like :func:`device_batches` but fed by the native C++ pipeline.
+
+    Augmentation (pad-crop/flip/standardize) and batch staging run in the
+    C++ worker pool (data/native.py) off the Python thread, so host-side
+    preprocessing overlaps the device step. Sampling is uniform with
+    replacement (per-host independent streams via the seed), deterministic
+    for a fixed seed regardless of thread count. Raises RuntimeError when
+    the native library can't be built — callers fall back to
+    :func:`device_batches`.
+    """
+    from distributed_tensorflow_tpu.data.native import NativePipeline
+
+    sharding, proc, local_b = _global_batch_layout(mesh, global_batch)
+    pipe = NativePipeline(
+        dataset.images,
+        dataset.labels,
+        batch=local_b,
+        pad=pad,
+        flip=flip,
+        standardize=standardize,
+        seed=seed * 1000003 + proc,
+        n_threads=n_threads,
+    )
+    while True:
+        images, labels = pipe.next()
+        yield {
+            "image": jax.make_array_from_process_local_data(sharding, images),
+            "label": jax.make_array_from_process_local_data(sharding, labels),
+        }

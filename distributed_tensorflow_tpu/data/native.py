@@ -1,0 +1,134 @@
+"""ctypes bindings for the native (C++) input pipeline.
+
+The reference rides tf.data's C++ threadpool for its input pipelines
+(SURVEY.md §2); this is the rebuild's own native layer: a pthread worker
+pool in ``native/data_pipeline.cpp`` that shuffles, augments (pad-crop /
+flip / per-image standardization), and stages batches in a bounded ring —
+deterministic by construction (per-ticket RNG, in-order staging), unlike the
+reference's racy async readers.
+
+``NativePipeline`` builds the shared library on first use (g++ is in the
+image); if the toolchain is unavailable the caller falls back to the numpy
+path (``native_available()`` gates it).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libdata_pipeline.so"
+_lib = None
+_build_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    if not _LIB_PATH.exists():
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            logger.warning("native pipeline build failed, using numpy path: %s", e)
+            _build_failed = True
+            return None
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.dp_create.restype = ctypes.c_void_p
+    lib.dp_create.argtypes = [
+        ctypes.c_void_p,  # images
+        ctypes.c_void_p,  # labels
+        ctypes.c_int64,   # n
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c
+        ctypes.c_int,     # batch
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,  # pad, flip, standardize
+        ctypes.c_uint64,  # seed
+        ctypes.c_int, ctypes.c_int,  # n_threads, queue_cap
+    ]
+    lib.dp_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+    lib.dp_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativePipeline:
+    """Threaded batch producer over an in-memory dataset.
+
+    Yields ``(images [B,H,W,C] f32, labels [B] i32)`` numpy batches with
+    augmentation done by the C++ worker pool. Deterministic for a fixed
+    ``seed`` independent of ``n_threads``.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch: int,
+        *,
+        pad: int = 0,
+        flip: bool = False,
+        standardize: bool = False,
+        seed: int = 0,
+        n_threads: int = 4,
+        queue_cap: int = 8,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native pipeline library unavailable")
+        # Own contiguous copies: the C++ side keeps raw pointers to these.
+        self._images = np.ascontiguousarray(images, np.float32)
+        self._labels = np.ascontiguousarray(labels, np.int32)
+        n, h, w, c = self._images.shape
+        self._shape = (batch, h, w, c)
+        self._batch = batch
+        self._lib = lib
+        self._handle = lib.dp_create(
+            self._images.ctypes.data_as(ctypes.c_void_p),
+            self._labels.ctypes.data_as(ctypes.c_void_p),
+            n, h, w, c, batch,
+            pad, int(flip), int(standardize),
+            seed, n_threads, queue_cap,
+        )
+
+    def next(self) -> tuple[np.ndarray, np.ndarray]:
+        out_images = np.empty(self._shape, np.float32)
+        out_labels = np.empty((self._batch,), np.int32)
+        self._lib.dp_next(
+            self._handle,
+            out_images.ctypes.data_as(ctypes.c_void_p),
+            out_labels.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out_images, out_labels
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.dp_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

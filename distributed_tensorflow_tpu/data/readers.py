@@ -1,0 +1,143 @@
+"""Real-dataset readers, gated on local file presence (zero-egress env).
+
+Parity with the reference's per-workload input pipelines (SURVEY.md §2
+"Input pipelines" row): MNIST idx files and CIFAR-10 python-pickle batches
+load into the same in-memory :class:`SyntheticClassification` container the
+synthetic generators produce, so every downstream component (loader,
+train step, CLI) is agnostic to where the pixels came from.
+
+``load_dataset`` is the single entry: real data when the files exist under
+``data_dir``, seeded synthetic otherwise — the run never fails for lack of
+a download.
+"""
+
+from __future__ import annotations
+
+import gzip
+import logging
+import pickle
+import struct
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+from distributed_tensorflow_tpu.data.synthetic import (
+    SyntheticClassification,
+    synthetic_image_classification,
+)
+
+_MNIST_IMAGE_MAGIC = 2051
+_MNIST_LABEL_MAGIC = 2049
+
+
+def _open_maybe_gz(path: Path):
+    if path.suffix == ".gz":
+        return gzip.open(path, "rb")
+    return path.open("rb")
+
+
+def _read_idx_images(path: Path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != _MNIST_IMAGE_MAGIC:
+            raise ValueError(f"{path}: bad idx image magic {magic}")
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path: Path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != _MNIST_LABEL_MAGIC:
+            raise ValueError(f"{path}: bad idx label magic {magic}")
+        return np.frombuffer(f.read(n), np.uint8).astype(np.int32)
+
+
+def _find(data_dir: Path, names: list[str]) -> Path | None:
+    for name in names:
+        for cand in (data_dir / name, data_dir / (name + ".gz")):
+            if cand.exists():
+                return cand
+    return None
+
+
+def load_mnist(data_dir: str | Path, split: str = "train") -> SyntheticClassification:
+    """MNIST from idx files (optionally .gz). Pixels scaled to [0, 1]."""
+    data_dir = Path(data_dir)
+    prefix = "train" if split == "train" else "t10k"
+    img = _find(data_dir, [f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"])
+    lab = _find(data_dir, [f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels.idx1-ubyte"])
+    if img is None or lab is None:
+        raise FileNotFoundError(f"no MNIST {split} idx files under {data_dir}")
+    images = _read_idx_images(img).astype(np.float32) / 255.0
+    labels = _read_idx_labels(lab)
+    if len(images) != len(labels):
+        raise ValueError(f"{len(images)} images vs {len(labels)} labels")
+    return SyntheticClassification(images=images, labels=labels)
+
+
+def load_cifar10(
+    data_dir: str | Path, split: str = "train"
+) -> SyntheticClassification:
+    """CIFAR-10 from the python-version pickle batches. NHWC [0, 1] float."""
+    data_dir = Path(data_dir)
+    base = data_dir / "cifar-10-batches-py"
+    if not base.exists():
+        base = data_dir
+    names = (
+        [f"data_batch_{i}" for i in range(1, 6)] if split == "train" else ["test_batch"]
+    )
+    images, labels = [], []
+    for name in names:
+        path = base / name
+        if not path.exists():
+            raise FileNotFoundError(f"missing CIFAR-10 batch {path}")
+        with path.open("rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        raw = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        images.append(raw.astype(np.float32) / 255.0)
+        labels.append(np.asarray(d[b"labels"], np.int32))
+    return SyntheticClassification(
+        images=np.concatenate(images), labels=np.concatenate(labels)
+    )
+
+
+_LOADERS = {"mnist": load_mnist, "cifar10": load_cifar10}
+
+
+def load_dataset(
+    name: str,
+    data_dir: str | Path | None,
+    *,
+    split: str = "train",
+    fallback_examples: int = 4096,
+    image_shape: tuple[int, int, int] | None = None,
+    num_classes: int = 10,
+    seed: int = 0,
+) -> SyntheticClassification:
+    """Real data if present under ``data_dir``, else seeded synthetic.
+
+    The synthetic fallback mirrors the requested geometry so shapes (and
+    therefore compiled programs) are identical either way.
+    """
+    defaults = {"mnist": (28, 28, 1), "cifar10": (32, 32, 3)}
+    if name in _LOADERS and data_dir is not None:
+        try:
+            return _LOADERS[name](data_dir, split)
+        except FileNotFoundError as e:
+            # The user pointed at real data and didn't get it — training on
+            # synthetic noise must never look like a successful real run.
+            logger.warning(
+                "%s not found under %s (%s); FALLING BACK TO SYNTHETIC DATA",
+                name,
+                data_dir,
+                e,
+            )
+    shape = image_shape or defaults.get(name)
+    if shape is None:
+        raise ValueError(f"unknown dataset {name!r} and no image_shape given")
+    return synthetic_image_classification(
+        fallback_examples, shape, num_classes, seed=seed
+    )

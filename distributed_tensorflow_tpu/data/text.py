@@ -38,39 +38,40 @@ class SyntheticMLM:
         n_content = cfg.vocab_size - NUM_SPECIAL
         self._perm = rng.permutation(n_content)
 
-    def _chain(self, rng, length: int) -> np.ndarray:
+    def _chains(self, rng, nrows: int, length: int) -> np.ndarray:
+        """Vectorized Markov chains: [nrows, length] content tokens."""
         n = self.cfg.vocab_size - NUM_SPECIAL
-        out = np.empty(length, np.int64)
-        tok = rng.integers(0, n)
+        out = np.empty((nrows, length), np.int64)
+        tok = rng.integers(0, n, nrows)
         for i in range(length):
-            out[i] = tok
-            if rng.random() < self.cfg.noise:
-                tok = rng.integers(0, n)
-            else:
-                tok = self._perm[tok]
+            out[:, i] = tok
+            jump = rng.random(nrows) < self.cfg.noise
+            tok = np.where(jump, rng.integers(0, n, nrows), self._perm[tok])
         return out + NUM_SPECIAL
 
-    def batch(self, batch_size: int, *, seed: int) -> dict[str, np.ndarray]:
+    def batch(
+        self, batch_size: int, *, seed: int | tuple[int, ...]
+    ) -> dict[str, np.ndarray]:
+        """One batch, fully vectorized (the step-loop hot path on host)."""
         cfg = self.cfg
-        rng = np.random.default_rng((cfg.seed, seed))
+        key = (seed,) if isinstance(seed, int) else tuple(seed)
+        rng = np.random.default_rng((cfg.seed, *key))
         L = cfg.seq_len
         # [CLS] a... [SEP] b... [SEP] — split content evenly.
         n_a = (L - 3) // 2
         n_b = L - 3 - n_a
-        ids = np.zeros((batch_size, L), np.int32)
+        a = self._chains(rng, batch_size, n_a + n_b)
+        b_new = self._chains(rng, batch_size, n_b)
+        nsp = (rng.random(batch_size) < 0.5).astype(np.int32)  # 1 = random b
+        b = np.where(nsp[:, None] == 1, b_new, a[:, n_a:])
+        ids = np.empty((batch_size, L), np.int32)
+        ids[:, 0] = CLS
+        ids[:, 1 : n_a + 1] = a[:, :n_a]
+        ids[:, n_a + 1] = SEP
+        ids[:, n_a + 2 : n_a + 2 + n_b] = b
+        ids[:, -1] = SEP
         types = np.zeros((batch_size, L), np.int32)
-        nsp = np.zeros((batch_size,), np.int32)
-        for i in range(batch_size):
-            a = self._chain(rng, n_a + n_b)
-            if rng.random() < 0.5:
-                b = a[n_a:]
-                nsp[i] = 0
-            else:
-                b = self._chain(rng, n_b)
-                nsp[i] = 1
-            row = np.concatenate([[CLS], a[:n_a], [SEP], b[:n_b], [SEP]])
-            ids[i] = row
-            types[i, n_a + 2 :] = 1
+        types[:, n_a + 2 :] = 1
         attention_mask = np.ones((batch_size, L), bool)
 
         # BERT masking on content positions only.
@@ -129,8 +130,12 @@ def mlm_device_batches(
     """Infinite iterator of placed BERT batches.
 
     ``seq_sharded=True`` additionally shards the [B, L] leaves' second dim
-    over the mesh's ``"seq"`` axis (for ring-attention runs).
+    over the mesh's ``"seq"`` axis (for ring-attention runs). Each host
+    generates ONLY its local slice (per-host generator streams seeded by
+    ``(step, process_index)``) — no redundant global-batch work in the hot
+    loop.
     """
+    import numpy as np
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -138,6 +143,11 @@ def mlm_device_batches(
 
     dp = data_axes(mesh)
     dp_spec = dp if dp else None
+    n_dp = int(np.prod([mesh.shape[a] for a in dp], initial=1))
+    if global_batch % n_dp:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by DP world size {n_dp}"
+        )
     seq = "seq" if (seq_sharded and "seq" in mesh.axis_names) else None
     spec_2d = NamedSharding(mesh, P(dp_spec, seq))
     spec_1d = NamedSharding(mesh, P(dp_spec))
@@ -145,13 +155,10 @@ def mlm_device_batches(
     proc = jax.process_index()
     if global_batch % n_proc:
         raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    local_b = global_batch // n_proc
     step = 0
     while True:
-        full = dataset.batch(global_batch, seed=step)
-        local_b = global_batch // n_proc
-        local = {
-            k: v[proc * local_b : (proc + 1) * local_b] for k, v in full.items()
-        }
+        local = dataset.batch(local_b, seed=(seed, step, proc))
         yield {
             k: jax.make_array_from_process_local_data(
                 spec_1d if v.ndim == 1 else spec_2d, v

@@ -20,6 +20,7 @@ Kernel structure (one (batch, head, q-block) program per grid point):
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -265,7 +266,10 @@ def flash_attention(
     b, l, h, d = q.shape
     block_q = min(block_q, max(l, 8))
     block_k = min(block_k, max(l, 8))
-    l_pad = -(-l // max(block_q, block_k)) * max(block_q, block_k)
+    # Pad to a common multiple of BOTH blocks: padding to only the larger one
+    # leaves trailing q rows outside the grid (uninitialized output).
+    step = math.lcm(block_q, block_k)
+    l_pad = -(-l // step) * step
     if mask is None:
         mask = jnp.ones((b, l), bool)
     if l_pad != l:

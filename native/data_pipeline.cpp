@@ -1,0 +1,181 @@
+// Native host-side input pipeline: threaded shuffle/augment/prefetch.
+//
+// The TPU-native runtime analog of the input-pipeline layer the reference
+// gets from the TF C++ runtime (SURVEY.md §2 "Input pipelines" row; the repo
+// itself is Python, its native speed comes from tf.data's C++ threadpool).
+// Here the same capability is built directly: worker threads draw epoch
+// permutations, apply augmentation (pad-crop + horizontal flip + optional
+// per-image standardization), and stage finished batches in a bounded ring
+// so the Python step loop never blocks on augmentation — it only memcpy's
+// the next staged batch and hands it to jax.
+//
+// C ABI (ctypes-friendly), no external dependencies, C++17 + pthreads.
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<float> images;
+  std::vector<int32_t> labels;
+};
+
+struct Config {
+  const float* images;    // [n, h, w, c] contiguous
+  const int32_t* labels;  // [n]
+  int64_t n;
+  int h, w, c;
+  int batch;
+  int pad;              // pad-crop margin (0 = off)
+  int flip;             // 1 = random horizontal flip
+  int standardize;      // 1 = per-image mean/std normalization
+  uint64_t seed;
+};
+
+class Pipeline {
+ public:
+  Pipeline(const Config& cfg, int n_threads, int queue_cap)
+      : cfg_(cfg), cap_(queue_cap), stop_(false), next_ticket_(0), next_out_(0) {
+    if (n_threads < 1) n_threads = 1;
+    for (int t = 0; t < n_threads; ++t) {
+      workers_.emplace_back([this, t] { Work(t); });
+    }
+  }
+
+  ~Pipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_space_.notify_all();
+    cv_data_.notify_all();
+    for (auto& th : workers_) th.join();
+  }
+
+  // Blocks until the next in-order batch is staged, then copies it out.
+  void Next(float* out_images, int32_t* out_labels) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_data_.wait(lk, [this] { return stop_ || !ready_.empty(); });
+    if (stop_) return;
+    Batch b = std::move(ready_.front());
+    ready_.pop();
+    lk.unlock();
+    // notify_all: only the worker holding ticket == next_out_ can proceed;
+    // notify_one could wake a different one, which re-sleeps, and the
+    // eligible worker would wait forever — permanent stall.
+    cv_space_.notify_all();
+    std::memcpy(out_images, b.images.data(), b.images.size() * sizeof(float));
+    std::memcpy(out_labels, b.labels.data(), b.labels.size() * sizeof(int32_t));
+  }
+
+ private:
+  // Deterministic per-ticket RNG: batch k is identical regardless of thread
+  // count or interleaving — reproducibility is part of the framework's
+  // contract (the reference's async input raced; see SURVEY.md §4).
+  void Work(int /*tid*/) {
+    const int64_t img_elems = int64_t(cfg_.h) * cfg_.w * cfg_.c;
+    while (true) {
+      const uint64_t ticket = next_ticket_.fetch_add(1);
+      Batch b;
+      b.images.resize(size_t(cfg_.batch) * img_elems);
+      b.labels.resize(cfg_.batch);
+      std::mt19937_64 rng(cfg_.seed * 0x9E3779B97F4A7C15ULL + ticket);
+      for (int i = 0; i < cfg_.batch; ++i) {
+        const int64_t idx =
+            std::uniform_int_distribution<int64_t>(0, cfg_.n - 1)(rng);
+        const float* src = cfg_.images + idx * img_elems;
+        float* dst = b.images.data() + int64_t(i) * img_elems;
+        Augment(src, dst, rng);
+        b.labels[i] = cfg_.labels[idx];
+      }
+      // Stage in ticket order so output order is deterministic.
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_space_.wait(lk, [this, ticket] {
+        return stop_ ||
+               (ticket == next_out_ && ready_.size() < size_t(cap_));
+      });
+      if (stop_) return;
+      ready_.push(std::move(b));
+      ++next_out_;
+      lk.unlock();
+      cv_data_.notify_one();
+      cv_space_.notify_all();
+    }
+  }
+
+  void Augment(const float* src, float* dst, std::mt19937_64& rng) {
+    const int h = cfg_.h, w = cfg_.w, c = cfg_.c;
+    int dy = 0, dx = 0;
+    bool flip = false;
+    if (cfg_.pad > 0) {
+      dy = std::uniform_int_distribution<int>(-cfg_.pad, cfg_.pad)(rng);
+      dx = std::uniform_int_distribution<int>(-cfg_.pad, cfg_.pad)(rng);
+    }
+    if (cfg_.flip) flip = std::uniform_int_distribution<int>(0, 1)(rng) != 0;
+    for (int y = 0; y < h; ++y) {
+      const int sy = y + dy;
+      for (int x = 0; x < w; ++x) {
+        int sx = flip ? (w - 1 - x) + dx : x + dx;
+        float* d = dst + (int64_t(y) * w + x) * c;
+        if (sy < 0 || sy >= h || sx < 0 || sx >= w) {
+          std::memset(d, 0, sizeof(float) * c);
+        } else {
+          std::memcpy(d, src + (int64_t(sy) * w + sx) * c, sizeof(float) * c);
+        }
+      }
+    }
+    if (cfg_.standardize) {
+      const int64_t n = int64_t(h) * w * c;
+      double sum = 0, sq = 0;
+      for (int64_t i = 0; i < n; ++i) sum += dst[i];
+      const double mean = sum / n;
+      for (int64_t i = 0; i < n; ++i) {
+        const double v = dst[i] - mean;
+        sq += v * v;
+      }
+      // tf.image.per_image_standardization's adjusted stddev floor.
+      const double stddev = std::max(std::sqrt(sq / n), 1.0 / std::sqrt((double)n));
+      for (int64_t i = 0; i < n; ++i) {
+        dst[i] = float((dst[i] - mean) / stddev);
+      }
+    }
+  }
+
+  Config cfg_;
+  int cap_;
+  bool stop_;
+  std::atomic<uint64_t> next_ticket_;
+  uint64_t next_out_;
+  std::vector<std::thread> workers_;
+  std::queue<Batch> ready_;
+  std::mutex mu_;
+  std::condition_variable cv_data_, cv_space_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dp_create(const float* images, const int32_t* labels, int64_t n, int h,
+                int w, int c, int batch, int pad, int flip, int standardize,
+                uint64_t seed, int n_threads, int queue_cap) {
+  Config cfg{images, labels, n, h, w, c, batch, pad, flip, standardize, seed};
+  return new Pipeline(cfg, n_threads, queue_cap);
+}
+
+void dp_next(void* handle, float* out_images, int32_t* out_labels) {
+  static_cast<Pipeline*>(handle)->Next(out_images, out_labels);
+}
+
+void dp_destroy(void* handle) { delete static_cast<Pipeline*>(handle); }
+
+}  // extern "C"

@@ -73,3 +73,12 @@ def test_flash_bf16():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
     )
+
+
+def test_flash_mismatched_blocks_pad_to_lcm():
+    """block_q=16, block_k=24, L=24: padding must cover BOTH block grids."""
+    ks = jax.random.split(jax.random.key(5), 3)
+    q, k, v = (_rand(x, (1, 24, 2, 8)) for x in ks)
+    ref = dense_attention(q, k, v)
+    out = flash_attention(q, k, v, block_q=16, block_k=24)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
