@@ -16,7 +16,15 @@ preset                     reference configuration it rebuilds
 Every preset runs on any mesh size (DP width comes from the devices present,
 not from the config — there is no worker count to configure away). Datasets
 are seeded synthetic stand-ins with learnable structure (zero-egress
-environment); point ``--data-dir`` at real data when present (data/readers).
+environment); point ``--data-dir`` at real data when present (data/readers:
+MNIST idx, CIFAR pickles, ImageNet imagefolder/TFRecord caches).
+
+Round-2 capabilities beyond the preset table: warmup+decay LR schedules per
+workload, periodic held-out evaluation (``--eval-every``), the native C++
+input pipeline feeding the image presets (random-resized-crop/flip on the
+worker pool, prefetch off the Python thread), resume-correct data streams
+(a restored run consumes batches N.. not 0..), and ``--profile-dir`` xprof
+trace capture.
 """
 
 from __future__ import annotations
@@ -24,12 +32,15 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import logging
-from collections.abc import Callable
+from collections.abc import Callable, Iterator
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,37 +48,110 @@ class WorkloadConfig:
     """One training workload: model + data + optimization, mesh-agnostic."""
 
     name: str
-    build: Callable[["WorkloadConfig"], dict[str, Any]]  # returns the pieces
+    build: Callable[["WorkloadConfig"], Any]  # cfg -> make(mesh) -> pieces
     global_batch: int
     num_steps: int
     learning_rate: float
     momentum: float = 0.9
     optimizer: str = "sgd"  # "sgd" | "adam"
+    lr_schedule: str = "constant"  # "constant" | "warmup_cosine" | "piecewise"
+    warmup_steps: int = 0
     mode: str = "sync"  # "sync" | "stale"
     staleness: int = 0
     seq_parallel: int = 0  # >0: seq axis size for ring attention (BERT)
     image_size: int = 0  # overridable per run
     dataset: str = ""  # real-dataset name for data/readers.load_dataset
     data_dir: str = ""  # where to look for it; synthetic fallback otherwise
+    augment: str = ""  # "" | "cifar" (pad-crop+flip) | "imagenet" (RRC+flip)
+    native_input: bool = True  # use the C++ pipeline when buildable
     log_every: int = 50
     ckpt_every: int = 0
 
 
-def _make_tx(cfg: WorkloadConfig) -> optax.GradientTransformation:
+def make_lr_schedule(cfg: WorkloadConfig) -> optax.Schedule:
+    """The per-workload LR schedule (reference-era ImageNet/BERT recipes).
+
+    ``warmup_cosine``: linear warmup to the peak LR then cosine decay to ~0
+    over ``num_steps`` (the standard large-batch ImageNet/BERT recipe — the
+    linear-scaling rule's required companion). ``piecewise``: x0.1 at 50% and
+    75% of the run (classic step-decay ResNet recipe). ``constant``: the
+    reference harness's fixed LR.
+    """
+    if cfg.lr_schedule == "constant":
+        return optax.constant_schedule(cfg.learning_rate)
+    if cfg.lr_schedule == "warmup_cosine":
+        warmup = cfg.warmup_steps or max(1, cfg.num_steps // 20)
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=warmup,
+            decay_steps=max(cfg.num_steps, warmup + 1),
+            end_value=cfg.learning_rate * 1e-3,
+        )
+    if cfg.lr_schedule == "piecewise":
+        return optax.piecewise_constant_schedule(
+            cfg.learning_rate,
+            {cfg.num_steps // 2: 0.1, (3 * cfg.num_steps) // 4: 0.1},
+        )
+    raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+
+
+def _make_tx(cfg: WorkloadConfig) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    schedule = make_lr_schedule(cfg)
     if cfg.optimizer == "adam":
-        return optax.adam(cfg.learning_rate)
+        return optax.adam(schedule), schedule
     if cfg.momentum:
-        return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
-    return optax.sgd(cfg.learning_rate)
+        return optax.sgd(schedule, momentum=cfg.momentum), schedule
+    return optax.sgd(schedule), schedule
+
+
+def _image_batches(cfg, ds, mesh, model_hw, *, train, seed, start_step=0):
+    """Train/eval batch stream over an image dataset: native C++ pipeline
+    with augmentation when available, numpy fallback otherwise."""
+    from distributed_tensorflow_tpu.data import device_batches, native_device_batches
+    from distributed_tensorflow_tpu.data.native import native_available
+    from distributed_tensorflow_tpu.data.readers import IMAGENET_MEAN, IMAGENET_STD
+
+    store_hw = tuple(ds.images.shape[1:3])
+    is_u8 = ds.images.dtype == np.uint8
+    # Per-channel normalization belongs to the real-pixel path; synthetic
+    # float templates are already ~N(0,1).
+    mean = IMAGENET_MEAN if (is_u8 and cfg.augment == "imagenet") else None
+    std = IMAGENET_STD if mean is not None else None
+    out_size = model_hw if store_hw != model_hw else None
+    if train and cfg.native_input and native_available():
+        return native_device_batches(
+            ds,
+            mesh,
+            cfg.global_batch,
+            out_size=out_size,
+            pad=4 if cfg.augment == "cifar" else 0,
+            flip=cfg.augment in ("cifar", "imagenet"),
+            rrc=cfg.augment == "imagenet",
+            mean=mean,
+            stddev=std,
+            seed=seed,
+            start_step=start_step,
+        )
+    return device_batches(
+        ds,
+        mesh,
+        cfg.global_batch,
+        seed=seed,
+        start_step=start_step,
+        out_size=out_size,
+        mean=mean,
+        stddev=std,
+    )
 
 
 def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
     def build(cfg: WorkloadConfig):
-        from distributed_tensorflow_tpu.data import device_batches
         from distributed_tensorflow_tpu.data.readers import load_dataset
         from distributed_tensorflow_tpu.train.objectives import (
             init_model,
             make_classification_loss,
+            make_classification_metrics,
         )
 
         shape = image_shape
@@ -78,27 +162,52 @@ def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
             params, model_state = init_model(
                 model, jax.random.key(0), jnp.zeros((1, *shape), jnp.float32)
             )
-            ds = load_dataset(
-                cfg.dataset or "synthetic",
-                cfg.data_dir or None,
-                fallback_examples=max(n_examples, cfg.global_batch),
-                image_shape=shape,
-                num_classes=num_classes,
-                seed=0,
-            )
-            if tuple(ds.images.shape[1:]) != tuple(shape):
+
+            def load(split):
+                return load_dataset(
+                    cfg.dataset or "synthetic",
+                    cfg.data_dir or None,
+                    split=split,
+                    fallback_examples=max(n_examples, cfg.global_batch),
+                    image_shape=shape,
+                    num_classes=num_classes,
+                    seed=0 if split == "train" else 1,
+                )
+
+            ds = load("train")
+            store = tuple(ds.images.shape[1:3])
+            if store != shape[:2] and (
+                ds.images.dtype != np.uint8 or store[0] < shape[0] or store[1] < shape[1]
+            ):
                 raise ValueError(
                     f"dataset images are {ds.images.shape[1:]} but the model "
-                    f"was configured for {shape} (--image-size conflicts with "
-                    "the real dataset's geometry)"
+                    f"was configured for {shape}; a u8 store may only be "
+                    "LARGER than the model geometry (train-time crop)"
                 )
-            batches = device_batches(ds, mesh, cfg.global_batch, seed=1)
+            # Val split loads lazily on the first eval pass — preparing a
+            # real val cache (full PIL decode) must not tax runs that never
+            # evaluate (--eval-every=0).
+            eval_ds_box: list = []
+
+            def eval_batches(n_batches: int) -> Iterator[dict]:
+                if not eval_ds_box:
+                    eval_ds_box.append(load("val"))
+                it = _image_batches(
+                    cfg, eval_ds_box[0], mesh, shape[:2], train=False, seed=101
+                )
+                for _ in range(n_batches):
+                    yield next(it)
+
             return {
                 "params": params,
                 "model_state": model_state,
                 "loss_fn": make_classification_loss(model),
-                "batches": batches,
+                "batches": lambda start_step=0: _image_batches(
+                    cfg, ds, mesh, shape[:2], train=True, seed=1, start_step=start_step
+                ),
                 "batch_spec": None,
+                "metric_fn": make_classification_metrics(model),
+                "eval_batches": eval_batches,
             }
 
         return make
@@ -145,17 +254,23 @@ def _build_bert_workload(cfg_kwargs: dict):
                     vocab_size=init_cfg.vocab_size, seq_len=L, seed=0
                 )
             )
-            batches = mlm_device_batches(
-                data, mesh, cfg.global_batch, seq_sharded=bool(seq_parallel), seed=1
-            )
             return {
                 "params": variables["params"],
                 "model_state": {},
                 "loss_fn": make_bert_pretraining_loss(model),
-                "batches": batches,
+                "batches": lambda start_step=0: mlm_device_batches(
+                    data,
+                    mesh,
+                    cfg.global_batch,
+                    seq_sharded=bool(seq_parallel),
+                    seed=1,
+                    start_step=start_step,
+                ),
                 "batch_spec": bert_batch_specs(
                     mesh, seq_sharded=bool(seq_parallel)
                 ),
+                "metric_fn": None,
+                "eval_batches": None,
             }
 
         return make
@@ -186,7 +301,9 @@ def _presets() -> dict[str, WorkloadConfig]:
             global_batch=256,
             num_steps=2000,
             learning_rate=0.1,
+            lr_schedule="piecewise",
             dataset="cifar10",
+            augment="cifar",
         ),
         "imagenet_resnet50": WorkloadConfig(
             name="imagenet_resnet50",
@@ -196,6 +313,9 @@ def _presets() -> dict[str, WorkloadConfig]:
             global_batch=256,
             num_steps=5000,
             learning_rate=0.4,  # linear-scaling rule for large global batch
+            lr_schedule="warmup_cosine",
+            dataset="imagenet",
+            augment="imagenet",
         ),
         "imagenet_inception_async": WorkloadConfig(
             name="imagenet_inception_async",
@@ -209,8 +329,11 @@ def _presets() -> dict[str, WorkloadConfig]:
             num_steps=5000,
             learning_rate=0.05,
             momentum=0.0,
+            lr_schedule="warmup_cosine",
             mode="stale",
             staleness=4,
+            dataset="imagenet",
+            augment="imagenet",
         ),
         "bert_base": WorkloadConfig(
             name="bert_base",
@@ -221,6 +344,8 @@ def _presets() -> dict[str, WorkloadConfig]:
             num_steps=10000,
             learning_rate=1e-4,
             optimizer="adam",
+            lr_schedule="warmup_cosine",
+            warmup_steps=1000,
         ),
     }
 
@@ -230,7 +355,7 @@ PRESETS = _presets()
 
 def run(cfg: WorkloadConfig, args: argparse.Namespace):
     from distributed_tensorflow_tpu.ckpt import Checkpointer
-    from distributed_tensorflow_tpu.obs import make_metric_hook
+    from distributed_tensorflow_tpu.obs import make_metric_hook, trace_steps
     from distributed_tensorflow_tpu.parallel.mesh import (
         build_mesh,
         initialize_runtime,
@@ -238,6 +363,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     from distributed_tensorflow_tpu.train import (
         create_train_state,
         fit,
+        make_eval_step,
         make_train_step,
     )
     from distributed_tensorflow_tpu.train.step import place_state
@@ -251,7 +377,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         logging.info("workload=%s mesh=%s", cfg.name, dict(mesh.shape))
 
     pieces = cfg.build(cfg)(mesh)
-    tx = _make_tx(cfg)
+    tx, lr_schedule = _make_tx(cfg)
     state = place_state(
         create_train_state(
             pieces["params"],
@@ -274,21 +400,53 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     start = 0
     if ckpt is not None:
         state, start = ckpt.restore_latest(state)
-    hook = make_metric_hook(
-        logdir=args.tb_dir, jsonl=args.metrics_jsonl or None
+    # Resume-correct stream: batches start at N, not 0 (the fix for the
+    # reference-era replay-on-restart).
+    batches = pieces["batches"](start)
+
+    evaluate = None
+    if args.eval_every and pieces.get("metric_fn") and pieces.get("eval_batches"):
+        eval_step = make_eval_step(
+            pieces["metric_fn"], mesh, batch_spec=pieces["batch_spec"]
+        )
+
+        def evaluate(state):
+            sums: dict[str, float] = {}
+            k = 0
+            for batch in pieces["eval_batches"](args.eval_batches):
+                m = eval_step(state, batch)
+                for key, v in m.items():
+                    sums[key] = sums.get(key, 0.0) + float(v)
+                k += 1
+            return {key: v / max(k, 1) for key, v in sums.items()}
+
+    def lr_hook(step_: int, state_, metrics: dict) -> None:
+        # Mutates before the writers run (hook order) — `lr` lands in every
+        # JSONL/TB record without touching the compiled step.
+        if "loss" in metrics:
+            metrics["lr"] = float(lr_schedule(step_ - 1))
+
+    hook = make_metric_hook(logdir=args.tb_dir, jsonl=args.metrics_jsonl or None)
+    import contextlib
+
+    profile_cm = (
+        trace_steps(args.profile_dir) if args.profile_dir else contextlib.nullcontext()
     )
     try:
-        state, last = fit(
-            state,
-            step,
-            pieces["batches"],
-            num_steps=cfg.num_steps,
-            rng=jax.random.key(args.seed),
-            log_every=cfg.log_every,
-            hooks=(hook,),
-            checkpointer=ckpt,
-            ckpt_every=cfg.ckpt_every or args.ckpt_every,
-        )
+        with profile_cm:
+            state, last = fit(
+                state,
+                step,
+                batches,
+                num_steps=cfg.num_steps,
+                rng=jax.random.key(args.seed),
+                log_every=cfg.log_every,
+                hooks=(lr_hook, hook),
+                checkpointer=ckpt,
+                ckpt_every=cfg.ckpt_every or args.ckpt_every,
+                evaluate=evaluate,
+                eval_every=args.eval_every,
+            )
         if ckpt is not None and ckpt.latest_step() != int(state.step):
             ckpt.save(int(state.step), state, force=True)
     finally:
@@ -296,6 +454,9 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
             ckpt.close()
         for w in getattr(hook, "writers", ()):
             w.close()
+        close = getattr(batches, "close", None)
+        if close is not None:
+            close()
     return state, last
 
 
@@ -310,13 +471,24 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--seq-parallel", type=int, default=-1,
                         help="seq axis size for ring attention (BERT)")
     parser.add_argument("--staleness", type=int, default=-1)
+    parser.add_argument("--lr", type=float, default=0.0)
+    parser.add_argument("--lr-schedule", default="",
+                        choices=["", "constant", "warmup_cosine", "piecewise"])
     parser.add_argument("--log-every", type=int, default=0)
     parser.add_argument("--data-dir", default="",
                         help="directory with real dataset files (synthetic fallback)")
+    parser.add_argument("--no-native-input", action="store_true",
+                        help="force the numpy input path (skip the C++ pipeline)")
+    parser.add_argument("--eval-every", type=int, default=0,
+                        help="run held-out eval every N steps (0 = off)")
+    parser.add_argument("--eval-batches", type=int, default=8,
+                        help="number of global batches per eval pass")
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--ckpt-every", type=int, default=0)
     parser.add_argument("--tb-dir", default="")
     parser.add_argument("--metrics-jsonl", default="")
+    parser.add_argument("--profile-dir", default="",
+                        help="capture an xprof trace of the whole run to this dir")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -337,10 +509,16 @@ def main(argv: list[str] | None = None):
         overrides["staleness"] = args.staleness
         if args.staleness:
             overrides["mode"] = "stale"
+    if args.lr:
+        overrides["learning_rate"] = args.lr
+    if args.lr_schedule:
+        overrides["lr_schedule"] = args.lr_schedule
     if args.log_every:
         overrides["log_every"] = args.log_every
     if args.data_dir:
         overrides["data_dir"] = args.data_dir
+    if args.no_native_input:
+        overrides["native_input"] = False
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     state, last = run(cfg, args)

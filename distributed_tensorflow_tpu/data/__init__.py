@@ -16,7 +16,10 @@ from distributed_tensorflow_tpu.data.synthetic import (  # noqa: F401
     SyntheticClassification,
     synthetic_image_classification,
 )
-from distributed_tensorflow_tpu.data.loader import device_batches  # noqa: F401
+from distributed_tensorflow_tpu.data.loader import (  # noqa: F401
+    device_batches,
+    native_device_batches,
+)
 from distributed_tensorflow_tpu.data.text import (  # noqa: F401
     SyntheticMLM,
     SyntheticMLMConfig,

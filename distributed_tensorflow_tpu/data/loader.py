@@ -5,6 +5,12 @@ reader (SURVEY.md §3b); sharding is implicit in "each worker reads different
 files". Here sharding is explicit: each host builds its process-local slice
 of the global batch and the loader assembles one global ``jax.Array`` per
 leaf with the batch sharded over the DP mesh axes.
+
+Both producers are **stream-position indexed**: batch ``k`` of a run is a
+pure function of ``(seed, k)``, so a checkpoint-restored run passes
+``start_step=N`` and consumes batches ``N, N+1, ...`` — never replaying
+``0..N-1`` (the resume-correctness the reference's stateful queue runners
+could not give).
 """
 
 from __future__ import annotations
@@ -37,12 +43,23 @@ def _global_batch_layout(mesh, global_batch: int):
     return sharding, jax.process_index(), global_batch // n_proc
 
 
+def _center_crop(images: np.ndarray, out_hw: tuple[int, int]) -> np.ndarray:
+    h, w = images.shape[1:3]
+    oh, ow = out_hw
+    y0, x0 = max(0, (h - oh) // 2), max(0, (w - ow) // 2)
+    return images[:, y0 : y0 + oh, x0 : x0 + ow]
+
+
 def device_batches(
     dataset: SyntheticClassification,
     mesh,
     global_batch: int,
     *,
     seed: int = 0,
+    start_step: int = 0,
+    out_size: tuple[int, int] | None = None,
+    mean: np.ndarray | None = None,
+    stddev: np.ndarray | None = None,
 ) -> Iterator[dict]:
     """Infinite iterator of global batches sharded over the mesh's DP axes.
 
@@ -52,25 +69,40 @@ def device_batches(
     host computes the same permutation (same seed) and takes its own
     contiguous slice — the no-coordination equivalent of
     ``tf.data.Dataset.shard(num_hosts, host_id)`` (SURVEY.md §7 step 5).
+
+    ``start_step`` starts the stream at batch N (resume). uint8 datasets are
+    scaled to [0, 1] float; ``out_size`` center-crops (the numpy fallback for
+    the native pipeline's crop-resize path).
     """
     n = len(dataset)
     if global_batch > n:
         raise ValueError(f"global batch {global_batch} > dataset size {n}")
     sharding, proc, local_b = _global_batch_layout(mesh, global_batch)
-    epoch = 0
+    batches_per_epoch = n // global_batch
+    step = start_step
+    epoch, order = -1, None
     while True:
-        order = np.random.default_rng(seed + epoch).permutation(n)
-        for start in range(0, n - global_batch + 1, global_batch):
-            idx = order[start + proc * local_b : start + (proc + 1) * local_b]
-            local = {
-                "image": dataset.images[idx],
-                "label": dataset.labels[idx],
-            }
-            yield {
-                k: jax.make_array_from_process_local_data(sharding, v)
-                for k, v in local.items()
-            }
-        epoch += 1
+        e, slot = divmod(step, batches_per_epoch)
+        if e != epoch:
+            epoch, order = e, np.random.default_rng(seed + e).permutation(n)
+        lo = slot * global_batch + proc * local_b
+        idx = order[lo : lo + local_b]
+        images = dataset.images[idx]
+        if images.dtype == np.uint8:
+            images = images.astype(np.float32) / 255.0
+        if out_size is not None and images.shape[1:3] != tuple(out_size):
+            images = _center_crop(images, out_size)
+        if mean is not None:
+            images = (images - mean) / stddev
+        local = {
+            "image": np.ascontiguousarray(images, np.float32),
+            "label": dataset.labels[idx],
+        }
+        yield {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in local.items()
+        }
+        step += 1
 
 
 def native_device_batches(
@@ -78,38 +110,56 @@ def native_device_batches(
     mesh,
     global_batch: int,
     *,
+    out_size: tuple[int, int] | None = None,
     pad: int = 0,
     flip: bool = False,
     standardize: bool = False,
+    rrc: bool = False,
+    mean: np.ndarray | None = None,
+    stddev: np.ndarray | None = None,
     seed: int = 0,
+    start_step: int = 0,
     n_threads: int = 4,
 ) -> Iterator[dict]:
     """Like :func:`device_batches` but fed by the native C++ pipeline.
 
-    Augmentation (pad-crop/flip/standardize) and batch staging run in the
-    C++ worker pool (data/native.py) off the Python thread, so host-side
-    preprocessing overlaps the device step. Sampling is uniform with
-    replacement (per-host independent streams via the seed), deterministic
-    for a fixed seed regardless of thread count. Raises RuntimeError when
-    the native library can't be built — callers fall back to
-    :func:`device_batches`.
+    Augmentation (pad-crop/flip/standardize, or random-resized-crop +
+    per-channel normalization for ImageNet-style datasets) and batch staging
+    run in the C++ worker pool (data/native.py) off the Python thread, so
+    host-side preprocessing overlaps the device step. Sampling is per-epoch
+    permutation without replacement; all hosts share the epoch permutation
+    (same seed) and read disjoint strided slices. ``start_step`` resumes the
+    stream at batch N. Raises RuntimeError when the native library can't be
+    built — callers fall back to :func:`device_batches`.
     """
     from distributed_tensorflow_tpu.data.native import NativePipeline
 
+    if global_batch > len(dataset):
+        raise ValueError(f"global batch {global_batch} > dataset size {len(dataset)}")
     sharding, proc, local_b = _global_batch_layout(mesh, global_batch)
     pipe = NativePipeline(
         dataset.images,
         dataset.labels,
         batch=local_b,
+        out_size=out_size,
         pad=pad,
         flip=flip,
         standardize=standardize,
-        seed=seed * 1000003 + proc,
+        rrc=rrc,
+        mean=mean,
+        stddev=stddev,
+        seed=seed,
+        stream_offset=proc * local_b,
+        stream_stride=global_batch,
+        start_ticket=start_step,
         n_threads=n_threads,
     )
-    while True:
-        images, labels = pipe.next()
-        yield {
-            "image": jax.make_array_from_process_local_data(sharding, images),
-            "label": jax.make_array_from_process_local_data(sharding, labels),
-        }
+    try:
+        while True:
+            images, labels = pipe.next()
+            yield {
+                "image": jax.make_array_from_process_local_data(sharding, images),
+                "label": jax.make_array_from_process_local_data(sharding, labels),
+            }
+    finally:
+        pipe.close()

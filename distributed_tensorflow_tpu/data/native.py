@@ -2,10 +2,12 @@
 
 The reference rides tf.data's C++ threadpool for its input pipelines
 (SURVEY.md §2); this is the rebuild's own native layer: a pthread worker
-pool in ``native/data_pipeline.cpp`` that shuffles, augments (pad-crop /
-flip / per-image standardization), and stages batches in a bounded ring —
-deterministic by construction (per-ticket RNG, in-order staging), unlike the
-reference's racy async readers.
+pool in ``native/data_pipeline.cpp`` that samples a per-epoch permutation
+(without replacement, via an O(1) Feistel index permutation), augments
+(pad-crop / flip / per-image standardization for CIFAR; random-resized-crop
++ per-channel normalization for ImageNet), and stages batches in a bounded
+ring — deterministic by construction (per-ticket RNG, in-order staging),
+unlike the reference's racy async readers.
 
 ``NativePipeline`` builds the shared library on first use (g++ is in the
 image); if the toolchain is unavailable the caller falls back to the numpy
@@ -35,10 +37,13 @@ def _load() -> ctypes.CDLL | None:
         return _lib
     if _build_failed:
         return None
-    if not _LIB_PATH.exists():
+    src = _NATIVE_DIR / "data_pipeline.cpp"
+    if not _LIB_PATH.exists() or (
+        src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+    ):
         try:
             subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR)],
+                ["make", "-C", str(_NATIVE_DIR), "-B"],
                 check=True,
                 capture_output=True,
                 text=True,
@@ -54,11 +59,18 @@ def _load() -> ctypes.CDLL | None:
         ctypes.c_void_p,  # labels
         ctypes.c_int64,   # n
         ctypes.c_int, ctypes.c_int, ctypes.c_int,  # h, w, c
+        ctypes.c_int, ctypes.c_int,  # out_h, out_w
         ctypes.c_int,     # batch
         ctypes.c_int, ctypes.c_int, ctypes.c_int,  # pad, flip, standardize
+        ctypes.c_int, ctypes.c_float,  # rrc, rrc_min_area
+        ctypes.c_int,     # src_u8
+        ctypes.c_void_p, ctypes.c_void_p,  # mean, stddev
         ctypes.c_uint64,  # seed
+        ctypes.c_uint64, ctypes.c_uint64,  # stream_offset, stream_stride
+        ctypes.c_uint64,  # start_ticket
         ctypes.c_int, ctypes.c_int,  # n_threads, queue_cap
     ]
+    lib.dp_next.restype = ctypes.c_int
     lib.dp_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
     lib.dp_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
@@ -70,11 +82,23 @@ def native_available() -> bool:
 
 
 class NativePipeline:
-    """Threaded batch producer over an in-memory dataset.
+    """Threaded batch producer over an in-memory (or memory-mapped) dataset.
 
-    Yields ``(images [B,H,W,C] f32, labels [B] i32)`` numpy batches with
-    augmentation done by the C++ worker pool. Deterministic for a fixed
-    ``seed`` independent of ``n_threads``.
+    Yields ``(images [B,out_H,out_W,C] f32, labels [B] i32)`` numpy batches
+    with augmentation done by the C++ worker pool. Deterministic for a fixed
+    ``seed`` independent of ``n_threads``. Sampling is per-epoch permutation
+    without replacement; ``start_ticket`` resumes the stream at batch N
+    (checkpoint-resume without replaying data).
+
+    ``images`` may be float32 or uint8 (uint8 pixels are scaled by 1/255 —
+    pass an np.memmap for datasets that don't fit RAM). When
+    ``out_size != (H, W)`` or ``rrc=True``, images are (random-resized-)
+    cropped and bilinearly resampled to ``out_size``.
+
+    Multi-host: pass ``stream_offset = host_index * batch`` and
+    ``stream_stride = num_hosts * batch`` with the SAME seed everywhere —
+    all hosts then share each epoch's permutation and read disjoint slices
+    (the explicit form of tf.data's ``shard(num_hosts, host_id)``).
     """
 
     def __init__(
@@ -83,39 +107,73 @@ class NativePipeline:
         labels: np.ndarray,
         batch: int,
         *,
+        out_size: tuple[int, int] | None = None,
         pad: int = 0,
         flip: bool = False,
         standardize: bool = False,
+        rrc: bool = False,
+        rrc_min_area: float = 0.08,
+        mean: np.ndarray | None = None,
+        stddev: np.ndarray | None = None,
         seed: int = 0,
+        stream_offset: int = 0,
+        stream_stride: int = 0,
+        start_ticket: int = 0,
         n_threads: int = 4,
         queue_cap: int = 8,
     ):
         lib = _load()
         if lib is None:
             raise RuntimeError("native pipeline library unavailable")
-        # Own contiguous copies: the C++ side keeps raw pointers to these.
-        self._images = np.ascontiguousarray(images, np.float32)
+        # Own contiguous arrays: the C++ side keeps raw pointers to these.
+        # uint8 sources stay uint8 (4x smaller; memmaps pass through without
+        # materializing), anything else becomes float32.
+        if images.dtype == np.uint8:
+            self._images = images if images.flags["C_CONTIGUOUS"] else np.ascontiguousarray(images)
+            src_u8 = 1
+        else:
+            self._images = np.ascontiguousarray(images, np.float32)
+            src_u8 = 0
         self._labels = np.ascontiguousarray(labels, np.int32)
         n, h, w, c = self._images.shape
-        self._shape = (batch, h, w, c)
+        oh, ow = out_size if out_size is not None else (h, w)
+        self._shape = (batch, oh, ow, c)
         self._batch = batch
         self._lib = lib
+        self._mean = (
+            np.ascontiguousarray(mean, np.float32) if mean is not None else None
+        )
+        self._std = (
+            np.ascontiguousarray(stddev, np.float32) if stddev is not None else None
+        )
+        if (self._mean is None) != (self._std is None):
+            raise ValueError("mean and stddev must be given together")
         self._handle = lib.dp_create(
             self._images.ctypes.data_as(ctypes.c_void_p),
             self._labels.ctypes.data_as(ctypes.c_void_p),
-            n, h, w, c, batch,
+            n, h, w, c, oh, ow, batch,
             pad, int(flip), int(standardize),
-            seed, n_threads, queue_cap,
+            int(rrc), float(rrc_min_area), src_u8,
+            self._mean.ctypes.data_as(ctypes.c_void_p) if self._mean is not None else None,
+            self._std.ctypes.data_as(ctypes.c_void_p) if self._std is not None else None,
+            seed, stream_offset, stream_stride, start_ticket,
+            n_threads, queue_cap,
         )
 
     def next(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._handle is None:
+            raise RuntimeError("pipeline is closed")
         out_images = np.empty(self._shape, np.float32)
         out_labels = np.empty((self._batch,), np.int32)
-        self._lib.dp_next(
+        ok = self._lib.dp_next(
             self._handle,
             out_images.ctypes.data_as(ctypes.c_void_p),
             out_labels.ctypes.data_as(ctypes.c_void_p),
         )
+        if not ok:
+            # Racing close()/destruction: never hand back uninitialized
+            # buffers as if they were data.
+            raise RuntimeError("pipeline stopped while waiting for a batch")
         return out_images, out_labels
 
     def __iter__(self):

@@ -16,6 +16,7 @@ from __future__ import annotations
 import gzip
 import logging
 import pickle
+import re
 import struct
 from pathlib import Path
 
@@ -104,7 +105,182 @@ def load_cifar10(
     )
 
 
-_LOADERS = {"mnist": load_mnist, "cifar10": load_cifar10}
+# ImageNet channel statistics (RGB, [0,1] pixel scale) — the standard
+# normalization constants for ImageNet-trained CNNs.
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _decode_resize_center(img, size: int) -> np.ndarray:
+    """PIL image -> RGB u8 [size, size, 3]: shorter side to ``size``, center crop."""
+    from PIL import Image
+
+    img = img.convert("RGB")
+    w, h = img.size
+    scale = size / min(w, h)
+    img = img.resize(
+        (max(size, int(round(w * scale))), max(size, int(round(h * scale)))),
+        Image.BILINEAR,
+    )
+    w, h = img.size
+    x0, y0 = (w - size) // 2, (h - size) // 2
+    return np.asarray(img.crop((x0, y0, x0 + size, y0 + size)), np.uint8)
+
+
+_IMG_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+
+def prepare_imagefolder(
+    src_dir: str | Path, cache_dir: str | Path, *, size: int = 256
+) -> Path:
+    """Decode a class-subdirectory image tree into a memmap-able u8 cache.
+
+    Layout in: ``src_dir/<class_name>/*.jpg`` (the torchvision ImageFolder /
+    ImageNet "train" convention). Layout out: ``cache_dir/images.npy``
+    (``[N, size, size, 3] u8``, written incrementally via ``open_memmap`` so
+    ImageNet-scale sets never materialize in RAM), ``labels.npy``,
+    ``classes.txt``. Returns ``cache_dir``.
+
+    The fixed-size u8 cache is the TPU-era answer to the reference's
+    per-worker JPEG-decode input pipelines: decode once offline, then the
+    native C++ pipeline random-resized-crops straight out of the OS page
+    cache at train time (SURVEY.md §7 hard-part 3).
+    """
+    from PIL import Image
+
+    src_dir, cache_dir = Path(src_dir), Path(cache_dir)
+    # "_"-prefixed dirs are cache/metadata (e.g. _cache_train_256), never
+    # classes — including one would silently shift every label index.
+    classes = sorted(
+        d.name for d in src_dir.iterdir() if d.is_dir() and not d.name.startswith("_")
+    )
+    if not classes:
+        raise FileNotFoundError(f"no class subdirectories under {src_dir}")
+    files: list[tuple[Path, int]] = []
+    for label, cls in enumerate(classes):
+        for p in sorted((src_dir / cls).rglob("*")):
+            if p.suffix.lower() in _IMG_EXTS:
+                files.append((p, label))
+    if not files:
+        raise FileNotFoundError(f"no image files under {src_dir}")
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    images = np.lib.format.open_memmap(
+        cache_dir / "images.npy",
+        mode="w+",
+        dtype=np.uint8,
+        shape=(len(files), size, size, 3),
+    )
+    labels = np.empty(len(files), np.int32)
+    for i, (path, label) in enumerate(files):
+        with Image.open(path) as img:
+            images[i] = _decode_resize_center(img, size)
+        labels[i] = label
+    images.flush()
+    np.save(cache_dir / "labels.npy", labels)
+    (cache_dir / "classes.txt").write_text("\n".join(classes) + "\n")
+    return cache_dir
+
+
+def prepare_tfrecords(
+    files: list[str | Path], cache_dir: str | Path, *, size: int = 256
+) -> Path:
+    """Decode ImageNet-style TFRecords into the same u8 cache layout.
+
+    Expects ``tf.Example`` records with ``image/encoded`` (JPEG bytes) and
+    ``image/class/label`` (int; 1-based per the classic ImageNet TFRecord
+    convention — stored as-is). Uses tf.data purely as a record
+    reader/parser (SURVEY.md §7 environment note: "tf available for tf.data
+    only"); pixels land in the cache once and tf never appears at train time.
+    """
+    import io
+
+    import tensorflow as tf
+    from PIL import Image
+
+    cache_dir = Path(cache_dir)
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    feature_spec = {
+        "image/encoded": tf.io.FixedLenFeature([], tf.string),
+        "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+    }
+    paths = [str(f) for f in files]
+    # Pass 1: count records (no decode) so the memmap can be sized up front
+    # and pixels stream straight to disk — ImageNet-scale sets must never
+    # materialize in RAM (same contract as prepare_imagefolder).
+    n = sum(1 for _ in tf.data.TFRecordDataset(paths))
+    if n == 0:
+        raise FileNotFoundError(f"no records in {files}")
+    images = np.lib.format.open_memmap(
+        cache_dir / "images.npy",
+        mode="w+",
+        dtype=np.uint8,
+        shape=(n, size, size, 3),
+    )
+    labels = np.empty(n, np.int32)
+    for i, raw in enumerate(tf.data.TFRecordDataset(paths)):
+        ex = tf.io.parse_single_example(raw, feature_spec)
+        with Image.open(io.BytesIO(ex["image/encoded"].numpy())) as img:
+            images[i] = _decode_resize_center(img, size)
+        labels[i] = int(ex["image/class/label"].numpy())
+    images.flush()
+    np.save(cache_dir / "labels.npy", labels)
+    return cache_dir
+
+
+def load_imagefolder(
+    data_dir: str | Path, split: str = "train", *, size: int = 256
+) -> SyntheticClassification:
+    """ImageNet-class data: u8 cache, raw imagefolder, or TFRecords.
+
+    Resolution order under ``data_dir`` (then ``data_dir/<split>``):
+
+    1. A prepared cache (``images.npy`` + ``labels.npy``) — memory-mapped,
+       so ImageNet-scale arrays cost no RAM up front.
+    2. Class subdirectories of images — prepared into
+       ``data_dir/_cache_<split>_<size>`` on first use, then memory-mapped.
+    3. ``<split>-*.tfrecord*`` / ``<split>-*`` TFRecord shards — same.
+
+    Images stay uint8 ``[N, size, size, 3]``; the train-time pipeline
+    (native C++ or numpy fallback) does the random-resized-crop to the model
+    geometry and the 1/255 scale.
+    """
+    data_dir = Path(data_dir)
+    if (data_dir / split).exists():
+        split_dir = data_dir / split
+    elif split == "train":
+        # Bare layout: class dirs / shards directly under data_dir.
+        split_dir = data_dir
+    else:
+        # Never silently serve train images as a val split.
+        raise FileNotFoundError(f"no {split!r} split under {data_dir}")
+
+    def _from_cache(cache: Path) -> SyntheticClassification:
+        return SyntheticClassification(
+            images=np.load(cache / "images.npy", mmap_mode="r"),
+            labels=np.load(cache / "labels.npy"),
+        )
+
+    for cand in (split_dir, data_dir / f"_cache_{split}_{size}"):
+        if (cand / "images.npy").exists() and (cand / "labels.npy").exists():
+            return _from_cache(cand)
+    cache = data_dir / f"_cache_{split}_{size}"
+    if any(d.is_dir() and not d.name.startswith("_") for d in split_dir.iterdir()):
+        return _from_cache(prepare_imagefolder(split_dir, cache, size=size))
+    # Only genuine record shards: "*.tfrecord*" or the classic
+    # "<split>-00000-of-01024" naming. Never directories or stray metadata
+    # files (train_stats.json would crash the record parser mid-prepare).
+    shard_re = re.compile(rf"(tfrecord|^{re.escape(split)}-\d+-of-\d+$)")
+    tfrecords = sorted(
+        p for p in split_dir.iterdir() if p.is_file() and shard_re.search(p.name)
+    )
+    if tfrecords:
+        return _from_cache(prepare_tfrecords(tfrecords, cache, size=size))
+    raise FileNotFoundError(
+        f"no prepared cache, class subdirectories, or TFRecords under {split_dir}"
+    )
+
+
+_LOADERS = {"mnist": load_mnist, "cifar10": load_cifar10, "imagenet": load_imagefolder}
 
 
 def load_dataset(

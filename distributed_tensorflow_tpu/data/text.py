@@ -126,6 +126,7 @@ def mlm_device_batches(
     *,
     seq_sharded: bool = False,
     seed: int = 0,
+    start_step: int = 0,
 ):
     """Infinite iterator of placed BERT batches.
 
@@ -156,7 +157,9 @@ def mlm_device_batches(
     if global_batch % n_proc:
         raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
     local_b = global_batch // n_proc
-    step = 0
+    # Stream-position indexed: batch k is a pure function of (seed, k), so a
+    # restored run resumes at batch N instead of replaying 0..N-1.
+    step = start_step
     while True:
         local = dataset.batch(local_b, seed=(seed, step, proc))
         yield {

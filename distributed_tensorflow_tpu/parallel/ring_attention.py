@@ -39,6 +39,12 @@ def dense_attention(q, k, v, mask=None):
     if mask is not None:
         s = jnp.where(mask[:, None, None, :], s, _MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
+    if mask is not None:
+        # A fully-masked query row softmaxes to uniform 1/Lk over _MASK_VALUE
+        # scores; zero it so such rows are exactly 0 — the same convention as
+        # ring_attention/flash_attention (denom-0 rows → 0). Partially-masked
+        # rows are unaffected (their masked probs are already exactly 0).
+        p = p * mask[:, None, None, :]
     return jnp.einsum(
         "bhlk,bkhd->blhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
     ).astype(q.dtype)

@@ -37,6 +37,8 @@ def fit(
     hooks: tuple[Hook, ...] = (),
     checkpointer=None,
     ckpt_every: int = 0,
+    evaluate: Callable[[Any], dict] | None = None,
+    eval_every: int = 0,
 ):
     """Run the training loop; returns the final state.
 
@@ -44,6 +46,9 @@ def fit(
     ``checkpointer``/``ckpt_every`` wire in periodic async checkpointing —
     the analog of the reference chief's periodic ``tf.train.Saver`` writes
     (SURVEY.md §5 checkpoint row), minus the chief: saving is collective.
+    ``evaluate(state) -> dict`` runs every ``eval_every`` steps (and at the
+    end); its metrics reach the hooks prefixed ``eval_`` — the held-out
+    accuracy loop the reference never had (SURVEY.md §4 "do better").
     """
     if rng is None:
         rng = jax.random.key(0)
@@ -69,6 +74,19 @@ def fit(
             for hook in hooks:
                 hook(step + 1, state, fetched)
             pending_metrics = fetched
+        if evaluate is not None and eval_every and (
+            (step + 1) % eval_every == 0 or step + 1 == num_steps
+        ):
+            ev = {f"eval_{k}": float(v) for k, v in evaluate(state).items()}
+            if jax.process_index() == 0:
+                logger.info(
+                    "step %d eval: %s",
+                    step + 1,
+                    " ".join(f"{k}={v:.5g}" for k, v in sorted(ev.items())),
+                )
+            for hook in hooks:
+                hook(step + 1, state, ev)
+            pending_metrics = {**(pending_metrics or {}), **ev}
         if checkpointer is not None and ckpt_every and (step + 1) % ckpt_every == 0:
             checkpointer.save(step + 1, state)
     return state, pending_metrics

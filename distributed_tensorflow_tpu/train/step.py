@@ -94,10 +94,14 @@ def make_train_step(
                 raise ValueError(
                     f"state.grad_buffer depth {depth} != staleness {staleness}"
                 )
-        # Per-device RNG: fold in the global step and the device's DP
-        # coordinate so dropout/augmentation differ per step and per shard.
+        # Per-device RNG: fold in the global step and the device's coordinate
+        # along every batch-sharding axis (DP axes and, under sequence
+        # parallelism, "seq") so dropout/augmentation is iid per step and per
+        # shard — without the "seq" fold every seq shard would draw the same
+        # dropout mask, making dropout periodic across the global sequence.
         rng = jax.random.fold_in(rng, state.step)
-        for ax in dp_axes:
+        rng_axes = list(dp_axes) + (["seq"] if "seq" in mesh.axis_names else [])
+        for ax in rng_axes:
             rng = jax.random.fold_in(rng, lax.axis_index(ax))
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Launch a training workload across every host of a TPU pod slice.
+#
+# The replacement for the reference's run_ps.py/run_worker.py + ssh-spray
+# deploy scripts (SURVEY.md §2 "Cluster deploy scripts" row): there are no
+# roles and no per-role flags — every host runs the IDENTICAL command below;
+# jax.distributed discovers the coordinator and process topology from the
+# TPU slice metadata, and the single SPMD program spans all chips.
+#
+# Usage (from your workstation, with gcloud configured):
+#
+#   TPU_NAME=my-v4-32 ZONE=us-central2-b ./scripts/launch_pod.sh \
+#       --config=imagenet_resnet50 \
+#       --data-dir=/mnt/data/imagenet \
+#       --ckpt-dir=gs://my-bucket/runs/r50 \
+#       --metrics-jsonl=/tmp/r50.jsonl
+#
+# Everything after the script name is passed through to the trainer verbatim.
+#
+# Conventions:
+#   * --ckpt-dir must be shared storage (GCS bucket or NFS) — checkpoint
+#     saves are collective; every host participates and any host can restore.
+#   * --data-dir is per-host local (each host reads its own shard of every
+#     global batch by process_index; see data/loader.py).
+#   * Logs/metrics are written by process 0 only; per-host stdout lands in
+#     the per-worker ssh streams below.
+#
+# For a localhost rehearsal of the multi-process path without a pod, see
+# tests/test_multiprocess.py (2 processes x 4 virtual CPU devices), which
+# exercises the exact same initialize_runtime() entry.
+
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the TPU VM/slice name}"
+: "${ZONE:?set ZONE to the TPU's GCE zone}"
+REPO_DIR="${REPO_DIR:-\$HOME/distributed_tensorflow_tpu}"
+
+# One identical command on every host of the slice. --worker=all is the
+# whole deploy script: no chief, no ps, no task indices.
+exec gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --zone="${ZONE}" \
+  --worker=all \
+  --command="cd ${REPO_DIR} && python -m distributed_tensorflow_tpu.cli.train $*"

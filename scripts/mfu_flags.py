@@ -1,0 +1,76 @@
+"""Try XLA:TPU tuning flags on the ResNet-50 train step (b=128/chip).
+
+Each variant runs in a subprocess so XLA_FLAGS take effect at backend init.
+
+    python scripts/mfu_flags.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BODY = r"""
+import sys, time
+sys.path.insert(0, %(repo)r)
+import jax, jax.numpy as jnp, numpy as np, optax
+from distributed_tensorflow_tpu.models import ResNet50
+from distributed_tensorflow_tpu.parallel import collectives as coll
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+from distributed_tensorflow_tpu.train.objectives import init_model, make_classification_loss
+from distributed_tensorflow_tpu.train.step import place_state
+
+b = 128
+mesh = build_mesh({"data": -1})
+model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+params, model_state = init_model(model, jax.random.key(0), jnp.zeros((1,224,224,3), jnp.float32))
+tx = optax.sgd(0.1, momentum=0.9)
+state = place_state(create_train_state(params, tx, model_state), mesh)
+step = make_train_step(make_classification_loss(model), tx, mesh)
+batch = coll.shard_batch({"image": np.random.default_rng(0).normal(size=(b,224,224,3)).astype(np.float32),
+                          "label": np.zeros((b,), np.int32)}, mesh)
+rng = jax.random.key(0)
+for _ in range(3):
+    state, m = step(state, batch, rng)
+float(m["loss"])
+n = 20
+t0 = time.perf_counter()
+for _ in range(n):
+    state, m = step(state, batch, rng)
+float(m["loss"])
+dt = (time.perf_counter()-t0)/n
+print(f"RESULT {b/dt:.0f} img/s  {dt*1e3:.1f} ms/step  mfu={b/dt*3*4.09e9/197e12:.3f}", flush=True)
+"""
+
+VARIANTS = {
+    "baseline": "",
+    "vmem64m": "--xla_tpu_scoped_vmem_limit_kib=65536",
+    "vmem32m": "--xla_tpu_scoped_vmem_limit_kib=32768",
+    "no_rewrite_infeed": "--xla_tpu_enable_aggressive_loop_fusion_layout_opt=true",
+    "async_fusion": "--xla_tpu_enable_async_collective_fusion=true --xla_tpu_enable_dot_strength_reduction=false",
+}
+
+
+def main():
+    for name, flags in VARIANTS.items():
+        env = dict(os.environ)
+        base = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = f"{base} {flags}".strip()
+        proc = subprocess.run(
+            [sys.executable, "-c", BODY % {"repo": REPO}],
+            env=env, capture_output=True, text=True, timeout=560,
+        )
+        line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT")]
+        if proc.returncode != 0 or not line:
+            err = (proc.stderr or "")[-300:]
+            print(f"{name}: FAILED rc={proc.returncode} {err}", flush=True)
+        else:
+            print(f"{name}: {line[0]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Worker body for the 2-process localhost cluster smoke test.
+
+The SPMD analog of the reference's "launch real ps/workers on localhost
+ports" testing idiom (SURVEY.md §4): N identical processes, one coordinator
+address, no roles. Run by tests/test_multiprocess.py:
+
+    python tests/_mp_worker.py <process_id> <num_processes> <port>
+
+Prints one JSON line with a digest of the final params; the launcher asserts
+every process converged to bit-identical replicated state.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    # 4 virtual CPU devices per process -> an 8-device global mesh. Must be
+    # set before the first backend touch (same trick as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_tpu.data import (
+        device_batches,
+        synthetic_image_classification,
+    )
+    from distributed_tensorflow_tpu.models import LeNet5
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        build_mesh,
+        initialize_runtime,
+    )
+    from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+    from distributed_tensorflow_tpu.train.objectives import (
+        init_model,
+        make_classification_loss,
+    )
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    initialize_runtime(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 4 * nproc, len(jax.devices())
+
+    mesh = build_mesh({"data": -1})
+    model = LeNet5()
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1), jnp.float32)
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    state = place_state(create_train_state(params, tx, model_state), mesh)
+    step = make_train_step(make_classification_loss(model), tx, mesh)
+
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+    batches = device_batches(ds, mesh, global_batch=32, seed=1)
+    rng = jax.random.key(0)
+    loss = None
+    for _ in range(3):
+        state, metrics = step(state, next(batches), rng)
+        loss = float(metrics["loss"])
+
+    # Params are replicated; every process reads its addressable shard and
+    # digests it — identical across processes iff training stayed in lockstep.
+    leaves = jax.tree.leaves(state.params)
+    digest = float(
+        sum(np.abs(np.asarray(jax.device_get(x))).sum() for x in leaves)
+    )
+    print(
+        json.dumps(
+            {
+                "proc": proc_id,
+                "digest": round(digest, 6),
+                "loss": loss,
+                "step": int(state.step),
+                "n_devices": len(jax.devices()),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -93,11 +93,11 @@ def test_resume_continues_identically(tmp_path, data_mesh):
         fresh, _ = _setup(data_mesh)
         state_c, start = ckpt.restore_latest(fresh)
     assert start == 3
-    batches_c = device_batches(ds, data_mesh, global_batch=64, seed=3)
-    for i in range(6):
-        b = next(batches_c)
-        if i >= 3:
-            state_c, _ = step(state_c, b, rng)
+    # Resume-correct stream: start_step=N yields batches N.. directly — no
+    # manual fast-forward (the loader is stream-position indexed).
+    batches_c = device_batches(ds, data_mesh, global_batch=64, seed=3, start_step=start)
+    for _ in range(3):
+        state_c, _ = step(state_c, next(batches_c), rng)
 
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
@@ -106,6 +106,19 @@ def test_resume_continues_identically(tmp_path, data_mesh):
         jax.device_get(state_a.params),
         jax.device_get(state_c.params),
     )
+
+
+def test_device_batches_start_step_matches_stream(data_mesh):
+    """Batch k is a pure function of (seed, k): start_step=N == skipping N."""
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=8)
+    a = device_batches(ds, data_mesh, global_batch=64, seed=9)
+    for _ in range(5):
+        next(a)
+    b = device_batches(ds, data_mesh, global_batch=64, seed=9, start_step=5)
+    for _ in range(3):
+        x, y = next(a), next(b)
+        np.testing.assert_array_equal(np.asarray(x["image"]), np.asarray(y["image"]))
+        np.testing.assert_array_equal(np.asarray(x["label"]), np.asarray(y["label"]))
 
 
 def test_stale_buffer_roundtrips(tmp_path, data_mesh):

@@ -67,6 +67,52 @@ def test_cli_resnet20_small(tmp_path):
     assert rc == 0
 
 
+def test_cli_lr_schedule_and_eval(tmp_path):
+    """Decaying lr and held-out eval accuracy both land in the JSONL."""
+    rc = main(
+        [
+            "--config=mnist_lenet",
+            "--lr-schedule=warmup_cosine",
+            "--steps=8",
+            "--global-batch=32",
+            "--log-every=2",
+            "--eval-every=4",
+            "--eval-batches=2",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    lrs = {r["step"]: r["lr"] for r in lines if "lr" in r}
+    assert len(lrs) >= 3
+    # warmup_cosine with default warmup (num_steps//20 → 1 step): decaying
+    # after warmup, and never constant across the run.
+    assert len(set(lrs.values())) > 1
+    evals = [r for r in lines if "eval_accuracy" in r]
+    assert evals and {r["step"] for r in evals} == {4, 8}
+    assert all("eval_loss" in r for r in evals)
+
+
+def test_cli_resume_does_not_replay_data(tmp_path):
+    """A restored run consumes batches N.. — the JSONL of a 4+4 resumed run
+    must match an 8-step straight run exactly (same data stream)."""
+    straight = [
+        "--config=mnist_lenet",
+        "--global-batch=32",
+        "--log-every=8",
+        "--no-native-input",
+    ]
+    rc = main(straight + ["--steps=8", f"--metrics-jsonl={tmp_path}/a.jsonl"])
+    assert rc == 0
+    resumed = straight + [f"--ckpt-dir={tmp_path}/ck", f"--metrics-jsonl={tmp_path}/b.jsonl"]
+    assert main(resumed + ["--steps=4"]) == 0
+    assert main(resumed + ["--steps=8"]) == 0
+    a = json.loads((tmp_path / "a.jsonl").read_text().splitlines()[-1])
+    b = json.loads((tmp_path / "b.jsonl").read_text().splitlines()[-1])
+    assert b["step"] == 8
+    assert abs(a["loss"] - b["loss"]) < 1e-5, (a["loss"], b["loss"])
+
+
 @pytest.mark.slow
 def test_cli_inception_stale_small(tmp_path):
     rc = main(

@@ -106,10 +106,15 @@ def test_inception_stale_mode_trains(devices8):
 
 
 def test_stale_mode_rejects_mismatched_buffer(data_mesh):
-    """Buffer-depth/staleness mismatch must fail loudly at trace time."""
-    model = InceptionV3(num_classes=10, aux_logits=False)
+    """Buffer-depth/staleness mismatch must fail loudly at trace time.
+
+    Exercises an engine error path — a tiny LeNet is the right fixture (the
+    check lives in train/step.py, not in any model)."""
+    from distributed_tensorflow_tpu.models import LeNet5
+
+    model = LeNet5()
     params, model_state = init_model(
-        model, jax.random.key(0), jnp.zeros((1, 75, 75, 3))
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1))
     )
     tx = optax.sgd(0.1)
     state = place_state(
@@ -119,7 +124,7 @@ def test_stale_mode_rejects_mismatched_buffer(data_mesh):
         make_classification_loss(model), tx, data_mesh, mode="stale", staleness=2
     )
     batch = {
-        "image": jnp.zeros((8, 75, 75, 3)),
+        "image": jnp.zeros((8, 28, 28, 1)),
         "label": jnp.zeros((8,), jnp.int32),
     }
     with pytest.raises(ValueError, match="grad_buffer depth"):

@@ -1,0 +1,65 @@
+"""Two-process localhost cluster smoke test (SURVEY.md §4 testing idiom).
+
+The reference validated its distributed path by launching real ps/worker
+processes on localhost ports (``ClusterSpec`` pointing at ``localhost:220x``).
+The SPMD analog: two OS processes, one jax.distributed coordinator, a global
+8-device CPU mesh (4 per process), and the assertion that sync-DP training
+keeps the replicated params bit-identical on every process — which the
+reference could only hope for, and only the chief could check.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = Path(__file__).resolve().parents[1]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sync_dp_localhost():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(_REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_REPO / "tests" / "_mp_worker.py"), str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=str(_REPO),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert {o["proc"] for o in outs} == {0, 1}
+    for o in outs:
+        assert o["n_devices"] == 8
+        assert o["step"] == 3
+    # The sync-DP invariant across real process boundaries: identical params.
+    assert outs[0]["digest"] == outs[1]["digest"], outs
+    assert outs[0]["loss"] == outs[1]["loss"], outs

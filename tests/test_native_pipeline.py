@@ -72,6 +72,98 @@ def test_pad_crop_changes_images():
     p.close()
 
 
+def test_epoch_is_permutation_without_replacement():
+    """Each epoch visits every example exactly once; epochs differ."""
+    n, b = 96, 8
+    images = np.zeros((n, 4, 4, 1), np.float32)
+    labels = np.arange(n, dtype=np.int32)
+    p = NativePipeline(images, labels, batch=b, seed=7, n_threads=3)
+    epochs = []
+    for _ in range(2):
+        seen = []
+        for _ in range(n // b):
+            seen.extend(p.next()[1].tolist())
+        assert sorted(seen) == list(range(n))
+        epochs.append(seen)
+    assert epochs[0] != epochs[1], "epoch permutations must differ"
+    p.close()
+
+
+def test_start_ticket_resumes_stream():
+    images, labels = _dataset(seed=5)
+
+    def take(k, start=0):
+        p = NativePipeline(images, labels, batch=8, flip=True, seed=9,
+                           start_ticket=start, n_threads=2)
+        out = [p.next() for _ in range(k)]
+        p.close()
+        return out
+
+    full = take(6)
+    resumed = take(3, start=3)
+    for (ia, la), (ib, lb) in zip(full[3:], resumed):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_uint8_source_rrc_resize_normalize():
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(32, 16, 16, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, 32).astype(np.int32)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    p = NativePipeline(images, labels, batch=4, out_size=(8, 8), rrc=True,
+                       flip=True, mean=mean, stddev=std, seed=1)
+    bi, bl = p.next()
+    assert bi.shape == (4, 8, 8, 3) and bi.dtype == np.float32
+    assert np.isfinite(bi).all()
+    # u8 pixels land in [0,1] before normalization, so outputs stay within
+    # the normalized range of [0,1] pixels.
+    lo = (0.0 - mean) / std
+    hi = (1.0 - mean) / std
+    assert (bi >= lo - 1e-5).all() and (bi <= hi + 1e-5).all()
+    p.close()
+
+
+def test_center_crop_when_rrc_off():
+    """out_size without rrc = deterministic center crop + resize (eval path)."""
+    images, labels = _dataset(n=16, h=12, w=12)
+    p1 = NativePipeline(images, labels, batch=16, out_size=(6, 6), seed=0)
+    p2 = NativePipeline(images, labels, batch=16, out_size=(6, 6), seed=0)
+    a, _ = p1.next()
+    b, _ = p2.next()
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (16, 6, 6, 3)
+    p1.close()
+    p2.close()
+
+
+def test_next_after_close_raises():
+    images, labels = _dataset()
+    p = NativePipeline(images, labels, batch=8, seed=0)
+    p.close()
+    with pytest.raises(RuntimeError):
+        p.next()
+
+
+def test_multihost_slices_tile_the_epoch():
+    """Two simulated hosts with one shared seed cover each epoch disjointly."""
+    n, b = 96, 8
+    images = np.zeros((n, 4, 4, 1), np.float32)
+    labels = np.arange(n, dtype=np.int32)
+    h0 = NativePipeline(images, labels, batch=b, seed=11,
+                        stream_offset=0, stream_stride=2 * b)
+    h1 = NativePipeline(images, labels, batch=b, seed=11,
+                        stream_offset=b, stream_stride=2 * b)
+    seen = []
+    for _ in range(n // (2 * b)):
+        seen.extend(h0.next()[1].tolist())
+        seen.extend(h1.next()[1].tolist())
+    assert sorted(seen) == list(range(n))
+    h0.close()
+    h1.close()
+
+
 def test_native_device_batches_trains(data_mesh):
     """Native pipeline feeds the SPMD step end-to-end."""
     import jax
