@@ -15,6 +15,7 @@ target from BASELINE.json:5 is the baseline bar.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -61,7 +62,9 @@ def main():
     devices = jax.devices()
     n = len(devices)
     on_tpu = devices[0].platform == "tpu"
-    per_chip_batch = 64 if on_tpu else 8
+    # b=128/chip won the r2 batch sweep (scripts/mfu_sweep.py: 0.136 @ 64,
+    # 0.158 @ 128, 0.156 @ 256, 0.147 @ 512 on v5e).
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
     image_hw = 224 if on_tpu else 64
     global_batch = per_chip_batch * n
 

@@ -182,15 +182,22 @@ def prepare_imagefolder(
 
 
 def prepare_tfrecords(
-    files: list[str | Path], cache_dir: str | Path, *, size: int = 256
+    files: list[str | Path],
+    cache_dir: str | Path,
+    *,
+    size: int = 256,
+    label_offset: int = 0,
 ) -> Path:
     """Decode ImageNet-style TFRecords into the same u8 cache layout.
 
     Expects ``tf.Example`` records with ``image/encoded`` (JPEG bytes) and
-    ``image/class/label`` (int; 1-based per the classic ImageNet TFRecord
-    convention — stored as-is). Uses tf.data purely as a record
-    reader/parser (SURVEY.md §7 environment note: "tf available for tf.data
-    only"); pixels land in the cache once and tf never appears at train time.
+    ``image/class/label``. The cache is 0-based (what the loss one-hot,
+    accuracy, and imagefolder caches all use); classic ILSVRC shards store
+    1-based labels (0 = background), so pass ``label_offset=1`` for those —
+    stored label = raw - offset, validated non-negative. Uses tf.data purely
+    as a record reader/parser (SURVEY.md §7 environment note: "tf available
+    for tf.data only"); pixels land in the cache once and tf never appears
+    at train time.
     """
     import io
 
@@ -221,7 +228,13 @@ def prepare_tfrecords(
         ex = tf.io.parse_single_example(raw, feature_spec)
         with Image.open(io.BytesIO(ex["image/encoded"].numpy())) as img:
             images[i] = _decode_resize_center(img, size)
-        labels[i] = int(ex["image/class/label"].numpy())
+        label = int(ex["image/class/label"].numpy()) - label_offset
+        if label < 0:
+            raise ValueError(
+                f"record {i}: label {label + label_offset} - offset "
+                f"{label_offset} is negative; wrong label_offset?"
+            )
+        labels[i] = label
     images.flush()
     np.save(cache_dir / "labels.npy", labels)
     return cache_dir

@@ -29,10 +29,62 @@ from __future__ import annotations
 from collections.abc import Callable, Sequence
 from functools import partial
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 ModuleDef = Callable[..., nn.Module]
+
+
+class PointwiseConv(nn.Module):
+    """1x1 convolution expressed as an explicit MXU matmul.
+
+    Mathematically identical to ``nn.Conv(features, (1, 1))`` (same
+    ``kernel`` param name/shape, so param trees and checkpoints are
+    interchangeable), but written as ``dot`` instead of
+    ``conv_general_dilated``. A strided 1x1 conv reads only the top-left
+    pixel of each stride window, so ``strides=2`` is exactly a spatial
+    slice followed by the matmul.
+
+    Measured r2 outcome (docs/PERF.md): XLA:TPU canonicalizes this back
+    into a rank-2 convolution and the full-model step time is unchanged —
+    the 1x1 layers are HBM-bandwidth-bound, not op-form-bound (a Pallas
+    matmul on the same shapes was no faster). Kept as the documented
+    experiment and for call sites that want the slice+matmul stride form;
+    the ResNet/Inception blocks use ``nn.Conv``.
+    """
+
+    features: int
+    strides: tuple[int, int] | int = 1
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.he_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        s = self.strides if isinstance(self.strides, int) else self.strides[0]
+        if s > 1:
+            x = x[:, ::s, ::s, :]
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", self.kernel_init, (1, 1, cin, self.features), jnp.float32
+        )
+        # Explicit 2D matmul: an einsum over [B,H,W,C] gets canonicalized
+        # back to a 1x1 convolution by XLA (verified on the r2 HLO — 0 dots,
+        # 161 convs), so flatten the spatial dims first. The reshapes are
+        # layout-preserving (C stays minormost) and the dot — including its
+        # tall-skinny wgrad transpose — stays on the matmul path.
+        b, h, w, _ = x.shape
+        y = jnp.dot(
+            x.astype(self.dtype).reshape(b * h * w, cin),
+            kernel[0, 0].astype(self.dtype),
+        ).reshape(b, h, w, self.features)
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+            )
+            y = y + bias.astype(self.dtype)
+        return y
 
 
 class BasicBlock(nn.Module):
@@ -89,11 +141,63 @@ class BottleneckBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+class SpaceToDepthStem(nn.Module):
+    """The ImageNet 7x7/s2 stem conv as a 2x2-space-to-depth 4x4/s1 conv.
+
+    The classic MLPerf TPU ResNet transform: a stride-2 7x7 conv on 3
+    channels maps terribly onto the MXU (contraction of only 7·7·3 = 147,
+    strided input reads). Folding a 2x2 pixel block into channels turns the
+    input into ``[B, H/2, W/2, 12]`` and the SAME math into an unstrided
+    4x4 conv (contraction 4·4·12 = 192, dense reads).
+
+    Bit-exact reparameterization, not an approximation: the 7x7 kernel is
+    zero-padded to 8x8 (one leading row/col — taps that would read outside
+    the original pad-3 window) and regrouped to ``[4, 4, 12, F]``; block
+    padding (2, 1) reproduces the original symmetric pad-3. The param is
+    the original ``kernel [7,7,3,F]`` (same name/shape as the plain conv
+    stem), so checkpoints are interchangeable.
+    """
+
+    features: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(f"space-to-depth stem needs even H/W, got {(h, w)}")
+        kernel = self.param(
+            "kernel",
+            nn.initializers.he_normal(),
+            (7, 7, c, self.features),
+            jnp.float32,
+        )
+        x = (
+            x.reshape(b, h // 2, 2, w // 2, 2, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(b, h // 2, w // 2, 4 * c)
+        )
+        k8 = jnp.pad(kernel.astype(self.dtype), ((1, 0), (1, 0), (0, 0), (0, 0)))
+        k2 = (
+            k8.reshape(4, 2, 4, 2, c, self.features)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(4, 4, 4 * c, self.features)
+        )
+        return jax.lax.conv_general_dilated(
+            x,
+            k2,
+            (1, 1),
+            [(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
 class ResNet(nn.Module):
     """Generic residual network over NHWC inputs.
 
-    ``stem="imagenet"`` → 7x7/2 conv + 3x3/2 maxpool (ResNet-50 et al.);
-    ``stem="cifar"``    → single 3x3 conv (ResNet-20/32/...).
+    ``stem="imagenet"`` → 7x7/2 conv + 3x3/2 maxpool (ResNet-50 et al.),
+    computed via :class:`SpaceToDepthStem` (same math, same params, MXU-
+    friendly layout); ``stem="cifar"`` → single 3x3 conv (ResNet-20/32/...).
     """
 
     stage_sizes: Sequence[int]
@@ -101,6 +205,8 @@ class ResNet(nn.Module):
     num_filters: int = 64
     num_classes: int = 1000
     stem: str = "imagenet"
+    stem_s2d: bool = True
+    remat: bool = False  # rematerialize blocks: trade (cheap) FLOPs for HBM
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -123,13 +229,18 @@ class ResNet(nn.Module):
             # Explicit symmetric padding (pad-3 conv, pad-1 pool): SAME would
             # compute asymmetric (2,3)/(0,1) pads on stride-2 and silently
             # shift activations vs. the canonical ResNet-50.
-            x = conv(
-                self.num_filters,
-                (7, 7),
-                strides=(2, 2),
-                padding=[(3, 3), (3, 3)],
-                name="stem_conv",
-            )(x)
+            if self.stem_s2d and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+                x = SpaceToDepthStem(
+                    self.num_filters, dtype=self.dtype, name="stem_conv"
+                )(x)
+            else:
+                x = conv(
+                    self.num_filters,
+                    (7, 7),
+                    strides=(2, 2),
+                    padding=[(3, 3), (3, 3)],
+                    name="stem_conv",
+                )(x)
             x = norm(name="stem_bn")(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -139,10 +250,11 @@ class ResNet(nn.Module):
             x = nn.relu(x)
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
+        block_cls = nn.remat(self.block) if self.remat else self.block
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = self.block(
+                x = block_cls(
                     self.num_filters * 2**i, strides=strides, conv=conv, norm=norm
                 )(x)
         x = jnp.mean(x, axis=(1, 2))

@@ -301,16 +301,21 @@ class Pipeline {
     // Bilinear resample crop box -> (oh, ow), align_corners=false convention.
     const float sy_scale = float(ch_) / oh, sx_scale = float(cw_) / ow;
     for (int y = 0; y < oh; ++y) {
-      const float fy = (y + 0.5f) * sy_scale - 0.5f + cy;
-      const int y0 = std::max(0, std::min(h - 1, int(std::floor(fy))));
-      const int y1 = std::max(0, std::min(h - 1, y0 + 1));
-      const float wy = fy - std::floor(fy);
+      // Clamp the source coordinate BEFORE taking floor/frac: an unclamped
+      // floor at fy < 0 (crop box touching the top/left border during
+      // upscale) would invert the blend weights toward the wrong row.
+      float fy = (y + 0.5f) * sy_scale - 0.5f + cy;
+      fy = std::max(0.0f, std::min(float(h - 1), fy));
+      const int y0 = int(fy);
+      const int y1 = std::min(h - 1, y0 + 1);
+      const float wy = fy - y0;
       for (int x = 0; x < ow; ++x) {
         const int xo = flip ? (ow - 1 - x) : x;
-        const float fx = (x + 0.5f) * sx_scale - 0.5f + cx;
-        const int x0 = std::max(0, std::min(w - 1, int(std::floor(fx))));
-        const int x1 = std::max(0, std::min(w - 1, x0 + 1));
-        const float wx = fx - std::floor(fx);
+        float fx = (x + 0.5f) * sx_scale - 0.5f + cx;
+        fx = std::max(0.0f, std::min(float(w - 1), fx));
+        const int x0 = int(fx);
+        const int x1 = std::min(w - 1, x0 + 1);
+        const float wx = fx - x0;
         float* d = dst + (int64_t(y) * ow + xo) * c;
         for (int chn = 0; chn < c; ++chn) {
           const float p00 = SrcPx(idx, y0, x0, chn);

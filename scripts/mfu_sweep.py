@@ -67,6 +67,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile", action="store_true")
     ap.add_argument("--batches", type=int, nargs="+", default=[64, 128, 256])
+    ap.add_argument("--remat", action="store_true")
     args = ap.parse_args()
 
     from distributed_tensorflow_tpu.models import ResNet50
@@ -82,6 +83,9 @@ def main():
     mesh = build_mesh({"data": -1})
     n = len(jax.devices())
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    if args.remat:
+        import dataclasses
+        model = dataclasses.replace(model, remat=True)
     params, model_state = init_model(
         model, jax.random.key(0), jnp.zeros((1, 224, 224, 3), jnp.float32)
     )

@@ -124,6 +124,11 @@ def test_tfrecord_prepare(tmp_path):
     labels = np.load(cache / "labels.npy")
     assert images.shape == (5, 24, 24, 3) and images.dtype == np.uint8
     np.testing.assert_array_equal(labels, np.arange(5) % 3)
+    # Classic 1-based ILSVRC labels normalize to 0-based via label_offset.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="negative"):
+        prepare_tfrecords([rec_path], tmp_path / "c2", size=24, label_offset=1)
 
 
 def test_imagefolder_through_training_path(tmp_path, data_mesh):

@@ -116,3 +116,39 @@ def test_resnet20_sync_dp_trains(devices8):
         ref = np.asarray(shards[0].data)
         for s in shards[1:]:
             np.testing.assert_array_equal(ref, np.asarray(s.data))
+
+
+def test_space_to_depth_stem_is_exact():
+    """SpaceToDepthStem is a bit-exact reparameterization of the 7x7/s2
+    pad-3 stem conv (same kernel param, MXU-friendly layout)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from distributed_tensorflow_tpu.models.resnet import SpaceToDepthStem
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(7, 7, 3, 64)) * 0.1, jnp.float32)
+    ref = nn.Conv(
+        64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)], use_bias=False
+    ).apply({"params": {"kernel": k}}, x)
+    got = SpaceToDepthStem(64).apply({"params": {"kernel": k}}, x)
+    assert got.shape == ref.shape == (2, 16, 16, 64)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=2e-4)
+
+
+def test_resnet50_odd_input_falls_back_to_plain_stem():
+    """Odd spatial sizes can't space-to-depth; the plain conv stem takes
+    over with the same param tree (no shape-dependent param surprises)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models import ResNet50
+    from distributed_tensorflow_tpu.train.objectives import init_model
+
+    model = ResNet50(num_classes=10)
+    p_even, _ = init_model(model, jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    p_odd, _ = init_model(model, jax.random.key(0), jnp.zeros((1, 75, 75, 3)))
+    assert jax.tree.structure(p_even) == jax.tree.structure(p_odd)
