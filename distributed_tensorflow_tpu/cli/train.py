@@ -220,6 +220,8 @@ def _build_bert_workload(cfg_kwargs: dict):
         from distributed_tensorflow_tpu.data.text import (
             SyntheticMLM,
             SyntheticMLMConfig,
+            TextCorpusConfig,
+            TextCorpusMLM,
             bert_batch_specs,
             mlm_device_batches,
         )
@@ -249,11 +251,32 @@ def _build_bert_workload(cfg_kwargs: dict):
                 jnp.zeros((1, L), jnp.int32),
                 train=False,
             )
-            data = SyntheticMLM(
-                SyntheticMLMConfig(
-                    vocab_size=init_cfg.vocab_size, seq_len=L, seed=0
+            # Real corpus when --data-dir holds *.txt (one sentence per
+            # line, blank line between documents — the classic BERT
+            # pretraining input); seeded synthetic Markov chains otherwise.
+            txt_files = []
+            if cfg.data_dir:
+                from pathlib import Path
+
+                txt_files = sorted(Path(cfg.data_dir).glob("*.txt"))
+            if txt_files:
+                data = TextCorpusMLM(
+                    txt_files,
+                    TextCorpusConfig(
+                        seq_len=L, vocab_size=init_cfg.vocab_size, seed=0
+                    ),
                 )
-            )
+            else:
+                if cfg.data_dir:
+                    logger.warning(
+                        "no *.txt under %s; FALLING BACK TO SYNTHETIC MLM DATA",
+                        cfg.data_dir,
+                    )
+                data = SyntheticMLM(
+                    SyntheticMLMConfig(
+                        vocab_size=init_cfg.vocab_size, seq_len=L, seed=0
+                    )
+                )
             return {
                 "params": variables["params"],
                 "model_state": {},

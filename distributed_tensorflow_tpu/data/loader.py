@@ -22,25 +22,19 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.data.synthetic import SyntheticClassification
-from distributed_tensorflow_tpu.parallel.mesh import batch_pspec, data_axes
+from distributed_tensorflow_tpu.parallel.mesh import batch_pspec, local_batch_size
 
 
 def _global_batch_layout(mesh, global_batch: int):
     """Shared validation + sharding for global-batch producers.
 
-    Returns ``(sharding, process_index, local_batch)`` after checking the
-    global batch divides both the DP world size and the host count.
+    Returns ``(sharding, process_index, local_batch)``;
+    ``local_batch_size`` does the divisibility validation (DP world size and
+    host count).
     """
-    n_dp = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
-    if global_batch % n_dp:
-        raise ValueError(
-            f"global batch {global_batch} not divisible by DP world size {n_dp}"
-        )
-    n_proc = jax.process_count()
-    if global_batch % n_proc:
-        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    local_b = local_batch_size(global_batch, mesh)
     sharding = NamedSharding(mesh, batch_pspec(mesh))
-    return sharding, jax.process_index(), global_batch // n_proc
+    return sharding, jax.process_index(), local_b
 
 
 def _center_crop(images: np.ndarray, out_hw: tuple[int, int]) -> np.ndarray:

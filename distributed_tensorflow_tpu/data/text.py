@@ -95,6 +95,159 @@ class SyntheticMLM:
         }
 
 
+UNK = 4
+NUM_SPECIAL_TEXT = 5  # PAD CLS SEP MASK UNK
+
+_WORD_RE = None  # compiled lazily
+
+
+def _words(line: str, lowercase: bool) -> list[str]:
+    global _WORD_RE
+    if _WORD_RE is None:
+        import re
+
+        _WORD_RE = re.compile(r"[a-zA-Z0-9']+|[^\sa-zA-Z0-9]")
+    if lowercase:
+        line = line.lower()
+    return _WORD_RE.findall(line)
+
+
+@dataclasses.dataclass
+class TextCorpusConfig:
+    """Real-text BERT pretraining corpus (SURVEY.md §2 BERT workload row —
+    the reference pretrained on real corpora; this is the real-data path the
+    synthetic Markov stand-in gates to)."""
+
+    seq_len: int = 128
+    vocab_size: int = 30522  # cap; actual vocab may be smaller
+    mask_prob: float = 0.15
+    lowercase: bool = True
+    seed: int = 0
+
+
+class TextCorpusMLM:
+    """BERT pretraining batches from plain-text files.
+
+    Format: one sentence per line; blank lines separate documents (the
+    classic BERT pretraining input convention). Tokenization is word-level
+    with an [UNK] bucket (vocab = most-frequent words up to
+    ``vocab_size``); masking/NSP semantics are identical to
+    :class:`SyntheticMLM` (15% masked: 80/10/10; 50% random next-sentence),
+    and the batch dict is interchangeable — ``mlm_device_batches`` and the
+    train step don't know which one they're fed.
+
+    Vocab layout: 0=[PAD] 1=[CLS] 2=[SEP] 3=[MASK] 4=[UNK], words 5..V-1.
+    """
+
+    def __init__(self, paths, cfg: TextCorpusConfig):
+        from collections import Counter
+        from pathlib import Path
+
+        self.cfg = cfg
+        sents: list[list[str]] = []
+        doc_last: list[bool] = []  # True if sentence ends its document
+        for path in paths:
+            doc_open = False
+            for line in Path(path).read_text().splitlines():
+                ws = _words(line, cfg.lowercase)
+                if not ws:
+                    if doc_open and doc_last:
+                        doc_last[-1] = True
+                    doc_open = False
+                    continue
+                sents.append(ws)
+                doc_last.append(False)
+                doc_open = True
+            if doc_last:
+                doc_last[-1] = True
+        if not sents:
+            raise ValueError(f"no sentences found in {list(paths)}")
+        freq = Counter(w for s in sents for w in s)
+        n_words = min(len(freq), cfg.vocab_size - NUM_SPECIAL_TEXT)
+        self.vocab = [w for w, _ in freq.most_common(n_words)]
+        self._ids = {w: NUM_SPECIAL_TEXT + i for i, w in enumerate(self.vocab)}
+        self.vocab_size = NUM_SPECIAL_TEXT + n_words
+        self._sents = [
+            np.asarray([self._ids.get(w, UNK) for w in s], np.int32) for s in sents
+        ]
+        self._doc_last = np.asarray(doc_last)
+
+    def _segment(self, start: int, budget: int) -> tuple[np.ndarray, int, bool]:
+        """Pack consecutive sentences from ``start`` into <= budget tokens.
+
+        Returns ``(tokens, next_idx, doc_ended)``: ``next_idx`` is the first
+        sentence AFTER the ones consumed (where a true next-sentence
+        continuation must start) and ``doc_ended`` whether the segment's
+        document (or the corpus) ends at its last sentence — in which case
+        no continuation exists.
+        """
+        out: list[np.ndarray] = []
+        n, i = 0, start
+        while True:
+            s = self._sents[i]
+            out.append(s[: budget - n])
+            n += len(out[-1])
+            at_end = bool(self._doc_last[i]) or i + 1 >= len(self._sents)
+            if n >= budget or at_end:
+                return np.concatenate(out), i + 1, at_end
+            i += 1
+
+    def batch(
+        self, batch_size: int, *, seed: int | tuple[int, ...]
+    ) -> dict[str, np.ndarray]:
+        cfg = self.cfg
+        key = (seed,) if isinstance(seed, int) else tuple(seed)
+        rng = np.random.default_rng((cfg.seed, 1, *key))
+        L = cfg.seq_len
+        n_a = (L - 3) // 2
+        n_b = L - 3 - n_a
+        ids = np.full((batch_size, L), PAD, np.int32)
+        types = np.zeros((batch_size, L), np.int32)
+        nsp = (rng.random(batch_size) < 0.5).astype(np.int32)  # 1 = random b
+        n_sents = len(self._sents)
+        for r in range(batch_size):
+            start = int(rng.integers(0, n_sents))
+            a, nxt, doc_ended = self._segment(start, n_a)
+            # Continuation = the sentence right after the ones A consumed,
+            # same document; random = an unrelated position (NSP label 1).
+            # If A ran to its document's end, no continuation exists — fall
+            # back to a random segment and relabel the pair as random.
+            if nsp[r] or doc_ended:
+                nsp[r] = 1
+                b, _, _ = self._segment(int(rng.integers(0, n_sents)), n_b)
+            else:
+                b, _, _ = self._segment(nxt, n_b)
+            row_len = 1 + len(a) + 1 + len(b) + 1
+            ids[r, 0] = CLS
+            ids[r, 1 : 1 + len(a)] = a
+            ids[r, 1 + len(a)] = SEP
+            ids[r, 2 + len(a) : 2 + len(a) + len(b)] = b
+            ids[r, row_len - 1] = SEP
+            types[r, 2 + len(a) : row_len] = 1
+        attention_mask = ids != PAD
+
+        # Identical masking recipe to SyntheticMLM (content = non-special,
+        # which here includes [UNK]).
+        content = ids >= NUM_SPECIAL
+        rr = rng.random(ids.shape)
+        selected = content & (rr < cfg.mask_prob)
+        targets = np.where(selected, ids, -1).astype(np.int32)
+        action = rng.random(ids.shape)
+        masked_ids = ids.copy()
+        masked_ids[selected & (action < 0.8)] = MASK
+        rand_sites = selected & (action >= 0.8) & (action < 0.9)
+        masked_ids[rand_sites] = rng.integers(
+            NUM_SPECIAL_TEXT, self.vocab_size, size=int(rand_sites.sum())
+        )
+        return {
+            "input_ids": masked_ids,
+            "attention_mask": attention_mask,
+            "token_type_ids": types,
+            "mlm_targets": targets,
+            "nsp_label": nsp,
+        }
+
+
 def bert_batch_specs(mesh, *, seq_sharded: bool = False) -> dict:
     """Per-leaf PartitionSpecs for a BERT batch (pass as train-step batch_spec).
 
@@ -140,23 +293,15 @@ def mlm_device_batches(
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from distributed_tensorflow_tpu.parallel.mesh import data_axes
+    from distributed_tensorflow_tpu.parallel.mesh import data_axes, local_batch_size
 
     dp = data_axes(mesh)
     dp_spec = dp if dp else None
-    n_dp = int(np.prod([mesh.shape[a] for a in dp], initial=1))
-    if global_batch % n_dp:
-        raise ValueError(
-            f"global batch {global_batch} not divisible by DP world size {n_dp}"
-        )
+    local_b = local_batch_size(global_batch, mesh)
     seq = "seq" if (seq_sharded and "seq" in mesh.axis_names) else None
     spec_2d = NamedSharding(mesh, P(dp_spec, seq))
     spec_1d = NamedSharding(mesh, P(dp_spec))
-    n_proc = jax.process_count()
     proc = jax.process_index()
-    if global_batch % n_proc:
-        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
-    local_b = global_batch // n_proc
     # Stream-position indexed: batch k is a pure function of (seed, k), so a
     # restored run resumes at batch N instead of replaying 0..N-1.
     step = start_step

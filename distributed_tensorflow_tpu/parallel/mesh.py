@@ -227,13 +227,18 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
-    """Per-host slice of the global batch (for building host-local arrays)."""
+    """Per-host slice of the global batch (for building host-local arrays).
+
+    The single rule every loader follows (data/loader.py, data/text.py):
+    each of the job's ``jax.process_count()`` hosts materializes an equal
+    contiguous slice; ``jax.make_array_from_process_local_data`` assembles
+    the global array. Validates divisibility by both the DP world size
+    (shard shapes must be static) and the host count.
+    """
     n_data = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
     if global_batch % n_data:
         raise ValueError(f"global batch {global_batch} not divisible by {n_data}")
-    per_device = global_batch // n_data
-    local_devices = sum(
-        1 for d in mesh.devices.flat if d.process_index == jax.process_index()
-    )
-    # Each host feeds its local devices' shards.
-    return per_device * max(1, local_devices * n_data // mesh.size)
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    return global_batch // n_proc

@@ -1,0 +1,63 @@
+"""Prepare an ImageNet-class dataset into the framework's u8 memmap cache.
+
+Decode once offline; training then random-resized-crops straight out of the
+OS page cache via the native C++ pipeline (SURVEY.md §7 hard-part 3).
+
+    # imagefolder (class subdirectories of images):
+    python scripts/prepare_imagenet.py --src /data/raw/train --out /data/cache/train
+
+    # TFRecord shards (classic ILSVRC: 1-based labels -> pass --label-offset 1):
+    python scripts/prepare_imagenet.py --tfrecords '/data/raw/train-*' \
+        --out /data/cache/train --label-offset 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--src", default="", help="imagefolder root (class subdirs)")
+    ap.add_argument("--tfrecords", default="", help="glob of TFRecord shards")
+    ap.add_argument("--out", required=True, help="cache dir (images.npy/labels.npy)")
+    ap.add_argument("--size", type=int, default=256, help="stored square size")
+    ap.add_argument("--label-offset", type=int, default=0,
+                    help="subtract from TFRecord labels (1 for classic ILSVRC)")
+    args = ap.parse_args()
+
+    from distributed_tensorflow_tpu.data.readers import (
+        prepare_imagefolder,
+        prepare_tfrecords,
+    )
+
+    t0 = time.perf_counter()
+    if bool(args.src) == bool(args.tfrecords):
+        ap.error("pass exactly one of --src / --tfrecords")
+    if args.src:
+        cache = prepare_imagefolder(args.src, args.out, size=args.size)
+    else:
+        shards = sorted(glob.glob(args.tfrecords))
+        if not shards:
+            ap.error(f"no shards match {args.tfrecords!r}")
+        cache = prepare_tfrecords(
+            shards, args.out, size=args.size, label_offset=args.label_offset
+        )
+    import numpy as np
+
+    labels = np.load(cache / "labels.npy")
+    print(
+        f"prepared {len(labels)} examples ({labels.max() + 1} classes) "
+        f"at {args.size}x{args.size} in {time.perf_counter() - t0:.1f}s -> {cache}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,166 @@
+"""Real-text BERT pretraining pipeline (SURVEY.md §2 BERT workload row)."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.text import (
+    CLS,
+    MASK,
+    NUM_SPECIAL,
+    PAD,
+    SEP,
+    UNK,
+    TextCorpusConfig,
+    TextCorpusMLM,
+)
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    (tmp_path / "a.txt").write_text(
+        "the quick brown fox jumps over the lazy dog\n"
+        "the dog sleeps all day\n"
+        "foxes are quick and clever\n"
+        "\n"
+        "distributed training needs fast input pipelines\n"
+        "the pipeline feeds the accelerator\n"
+        "accelerators are fast\n"
+    )
+    (tmp_path / "b.txt").write_text(
+        "tensor meshes shard the batch\n"
+        "collectives ride the interconnect\n"
+    )
+    return [tmp_path / "a.txt", tmp_path / "b.txt"]
+
+
+def test_vocab_frequency_and_unk(corpus):
+    ds = TextCorpusMLM(corpus, TextCorpusConfig(seq_len=32, seed=0))
+    assert ds.vocab[0] == "the"  # most frequent word gets the first id
+    assert ds.vocab_size <= TextCorpusConfig().vocab_size
+    # Capping the vocab buckets rare words into [UNK].
+    small = TextCorpusMLM(corpus, TextCorpusConfig(seq_len=32, vocab_size=8, seed=0))
+    assert small.vocab_size == 8
+    b = small.batch(8, seed=0)
+    assert (b["mlm_targets"] == UNK).sum() >= 0  # UNK is maskable content
+    assert int(b["input_ids"].max()) < small.vocab_size
+
+
+def test_batch_invariants(corpus):
+    cfg = TextCorpusConfig(seq_len=32, seed=1)
+    ds = TextCorpusMLM(corpus, cfg)
+    b = ds.batch(16, seed=3)
+    ids, mask = b["input_ids"], b["attention_mask"]
+    assert ids.shape == (16, 32) and mask.shape == (16, 32)
+    np.testing.assert_array_equal(mask, ids != PAD)
+    assert (ids[:, 0] == CLS).all()
+    # Every row has exactly two [SEP]s and type-1 tokens only in segment B.
+    assert ((ids == SEP).sum(axis=1) == 2).all()
+    types = b["token_type_ids"]
+    first_sep = (ids == SEP).argmax(axis=1)
+    for r in range(16):
+        assert types[r, : first_sep[r] + 1].max() == 0
+    # Targets only where content was selected; never on PAD/CLS/SEP.
+    t = b["mlm_targets"]
+    assert ((t == -1) | (t >= NUM_SPECIAL)).all()
+    assert set(np.unique(b["nsp_label"])) <= {0, 1}
+
+
+def test_mask_rate_and_determinism(corpus):
+    cfg = TextCorpusConfig(seq_len=64, seed=2)
+    ds = TextCorpusMLM(corpus, cfg)
+    b1 = ds.batch(64, seed=(5, 0))
+    b2 = ds.batch(64, seed=(5, 0))
+    for k in b1:
+        np.testing.assert_array_equal(b1[k], b2[k])
+    b3 = ds.batch(64, seed=(6, 0))
+    assert any(not np.array_equal(b1[k], b3[k]) for k in b1)
+    n_selected = (b1["mlm_targets"] != -1).sum()
+    total_content = n_selected + (
+        (b1["input_ids"] >= NUM_SPECIAL) & (b1["mlm_targets"] == -1)
+    ).sum()
+    rate = n_selected / max(total_content, 1)
+    assert 0.08 < rate < 0.25, rate
+    # 80% of selected sites show [MASK].
+    sel = b1["mlm_targets"] != -1
+    frac_mask = (b1["input_ids"][sel] == MASK).mean()
+    assert 0.6 < frac_mask < 0.95, frac_mask
+
+
+def test_nsp_continuation_is_true_next_sentence(tmp_path):
+    """nsp=0 pairs must pair A with the sentence RIGHT AFTER it, in the
+    same document — never skip one, never cross a document boundary."""
+    # One unique word per sentence -> token id identifies the sentence.
+    (tmp_path / "c.txt").write_text(
+        "alpha\nbravo\ncharlie\ndelta\necho\n\nxray\nyankee\nzulu\n"
+    )
+    ds = TextCorpusMLM(
+        [tmp_path / "c.txt"],
+        TextCorpusConfig(seq_len=7, mask_prob=0.0, seed=0),  # n_a = n_b = 2
+    )
+    order = [ds._ids[w] for w in ("alpha", "bravo", "charlie", "delta", "echo")]
+    doc2 = {ds._ids[w] for w in ("xray", "yankee", "zulu")}
+    follows = {order[i]: order[i + 1] for i in range(4)}
+    d2 = sorted(doc2)
+    follows.update({d2[0]: d2[1], d2[1]: d2[2]})
+    checked = 0
+    for s in range(20):
+        b = ds.batch(16, seed=s)
+        for r in range(16):
+            if b["nsp_label"][r]:
+                continue
+            ids = b["input_ids"][r]
+            seps = np.where(ids == SEP)[0]
+            a_last = int(ids[seps[0] - 1])
+            b_first = int(ids[seps[0] + 1])
+            assert follows.get(a_last) == b_first, (a_last, b_first)
+            checked += 1
+    assert checked > 20  # both labels actually occur
+
+
+def test_trains_end_to_end(corpus, data_mesh):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.data.text import bert_batch_specs, mlm_device_batches
+    from distributed_tensorflow_tpu.models.bert import (
+        BertConfig,
+        BertForPreTraining,
+        make_bert_pretraining_loss,
+    )
+    from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    ds = TextCorpusMLM(corpus, TextCorpusConfig(seq_len=32, seed=0))
+    cfg = BertConfig(
+        vocab_size=ds.vocab_size,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=32,
+        dropout_rate=0.0,
+    )
+    model = BertForPreTraining(cfg)
+    variables = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, 32), jnp.int32),
+        jnp.ones((1, 32), bool),
+        jnp.zeros((1, 32), jnp.int32),
+        train=False,
+    )
+    tx = optax.adam(1e-3)
+    state = place_state(create_train_state(variables["params"], tx), data_mesh)
+    step = make_train_step(
+        make_bert_pretraining_loss(model),
+        tx,
+        data_mesh,
+        batch_spec=bert_batch_specs(data_mesh),
+    )
+    batches = mlm_device_batches(ds, data_mesh, 16, seed=0)
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, next(batches), jax.random.key(1))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
