@@ -59,6 +59,7 @@ class WorkloadConfig:
     mode: str = "sync"  # "sync" | "stale"
     staleness: int = 0
     seq_parallel: int = 0  # >0: seq axis size for ring attention (BERT)
+    tensor_parallel: int = 0  # >0: model axis size for Megatron-TP (BERT)
     image_size: int = 0  # overridable per run
     dataset: str = ""  # real-dataset name for data/readers.load_dataset
     data_dir: str = ""  # where to look for it; synthetic fallback otherwise
@@ -232,13 +233,18 @@ def _build_bert_workload(cfg_kwargs: dict):
         )
 
         def make(mesh):
+            from distributed_tensorflow_tpu.models.bert import bert_param_specs
+
             seq_parallel = cfg.seq_parallel and "seq" in mesh.axis_names
+            tp = mesh.shape.get("model", 1)
             init_cfg = BertConfig(**cfg_kwargs)
-            model_cfg = (
-                dataclasses.replace(init_cfg, seq_axis="seq")
-                if seq_parallel
-                else init_cfg
-            )
+            model_cfg = init_cfg
+            if seq_parallel:
+                model_cfg = dataclasses.replace(model_cfg, seq_axis="seq")
+            if tp > 1:
+                model_cfg = dataclasses.replace(
+                    model_cfg, model_axis="model", model_parallel=tp
+                )
             # Init outside shard_map must not bind the seq axis; the param
             # tree is identical either way (tests/test_bert.py).
             init_model_ = BertForPreTraining(init_cfg)
@@ -279,6 +285,9 @@ def _build_bert_workload(cfg_kwargs: dict):
                 )
             return {
                 "params": variables["params"],
+                "param_specs": (
+                    bert_param_specs(variables["params"]) if tp > 1 else None
+                ),
                 "model_state": {},
                 "loss_fn": make_bert_pretraining_loss(model),
                 "batches": lambda start_step=0: mlm_device_batches(
@@ -392,24 +401,37 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     from distributed_tensorflow_tpu.train.step import place_state
 
     initialize_runtime()
-    mesh_spec = (
-        {"data": -1, "seq": cfg.seq_parallel} if cfg.seq_parallel else {"data": -1}
-    )
+    mesh_spec = {"data": -1}
+    if cfg.seq_parallel:
+        mesh_spec["seq"] = cfg.seq_parallel
+    if cfg.tensor_parallel:
+        mesh_spec["model"] = cfg.tensor_parallel
     mesh = build_mesh(mesh_spec)
     if jax.process_index() == 0:
         logging.info("workload=%s mesh=%s", cfg.name, dict(mesh.shape))
 
     pieces = cfg.build(cfg)(mesh)
+    if cfg.tensor_parallel > 1 and pieces.get("param_specs") is None:
+        # A model axis with no param sharding means every group of
+        # tensor_parallel devices computes identical grads — silent N-fold
+        # waste, never what the user asked for.
+        raise ValueError(
+            f"--tensor-parallel={cfg.tensor_parallel} is not supported by "
+            f"workload {cfg.name!r} (no tensor-parallel param sharding)"
+        )
     tx, lr_schedule = _make_tx(cfg)
-    state = place_state(
-        create_train_state(
-            pieces["params"],
-            tx,
-            pieces["model_state"],
-            staleness=cfg.staleness if cfg.mode == "stale" else 0,
-        ),
-        mesh,
+    host_state = create_train_state(
+        pieces["params"],
+        tx,
+        pieces["model_state"],
+        staleness=cfg.staleness if cfg.mode == "stale" else 0,
     )
+    state_specs = None
+    if pieces.get("param_specs") is not None:
+        from distributed_tensorflow_tpu.train.step import make_state_specs
+
+        state_specs = make_state_specs(host_state, tx, pieces["param_specs"])
+    state = place_state(host_state, mesh, state_specs)
     step = make_train_step(
         pieces["loss_fn"],
         tx,
@@ -417,6 +439,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         mode=cfg.mode,
         staleness=cfg.staleness if cfg.mode == "stale" else 0,
         batch_spec=pieces["batch_spec"],
+        state_specs=state_specs,
     )
 
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
@@ -493,6 +516,8 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--image-size", type=int, default=0)
     parser.add_argument("--seq-parallel", type=int, default=-1,
                         help="seq axis size for ring attention (BERT)")
+    parser.add_argument("--tensor-parallel", type=int, default=-1,
+                        help="model axis size for Megatron-TP sharding (BERT)")
     parser.add_argument("--staleness", type=int, default=-1)
     parser.add_argument("--lr", type=float, default=0.0)
     parser.add_argument("--lr-schedule", default="",
@@ -528,6 +553,8 @@ def main(argv: list[str] | None = None):
         overrides["image_size"] = args.image_size
     if args.seq_parallel >= 0:
         overrides["seq_parallel"] = args.seq_parallel
+    if args.tensor_parallel >= 0:
+        overrides["tensor_parallel"] = args.tensor_parallel
     if args.staleness >= 0:
         overrides["staleness"] = args.staleness
         if args.staleness:

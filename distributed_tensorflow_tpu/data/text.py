@@ -19,6 +19,29 @@ PAD, CLS, SEP, MASK = 0, 1, 2, 3
 NUM_SPECIAL = 4
 
 
+def _apply_bert_masking(rng, ids, mask_prob, rand_lo, rand_hi):
+    """The BERT masking recipe, shared by every MLM dataset: select
+    ``mask_prob`` of content positions (``ids >= NUM_SPECIAL``), then
+    80% → [MASK], 10% → random token from ``[rand_lo, rand_hi)``, 10% kept.
+    Returns ``(masked_ids, targets)`` with ``targets = -1`` off-selection.
+
+    Draw order (selection r, action, random replacements) is part of the
+    determinism contract — changing it changes every seeded batch.
+    """
+    content = ids >= NUM_SPECIAL
+    r = rng.random(ids.shape)
+    selected = content & (r < mask_prob)
+    targets = np.where(selected, ids, -1).astype(np.int32)
+    action = rng.random(ids.shape)
+    masked_ids = ids.copy()
+    masked_ids[selected & (action < 0.8)] = MASK
+    rand_sites = selected & (action >= 0.8) & (action < 0.9)
+    masked_ids[rand_sites] = rng.integers(
+        rand_lo, rand_hi, size=int(rand_sites.sum())
+    )
+    return masked_ids, targets
+
+
 @dataclasses.dataclass
 class SyntheticMLMConfig:
     vocab_size: int = 1000
@@ -74,17 +97,8 @@ class SyntheticMLM:
         types[:, n_a + 2 :] = 1
         attention_mask = np.ones((batch_size, L), bool)
 
-        # BERT masking on content positions only.
-        content = ids >= NUM_SPECIAL
-        r = rng.random(ids.shape)
-        selected = content & (r < cfg.mask_prob)
-        targets = np.where(selected, ids, -1).astype(np.int32)
-        action = rng.random(ids.shape)
-        masked_ids = ids.copy()
-        masked_ids[selected & (action < 0.8)] = MASK
-        rand_sites = selected & (action >= 0.8) & (action < 0.9)
-        masked_ids[rand_sites] = rng.integers(
-            NUM_SPECIAL, cfg.vocab_size, size=int(rand_sites.sum())
+        masked_ids, targets = _apply_bert_masking(
+            rng, ids, cfg.mask_prob, NUM_SPECIAL, cfg.vocab_size
         )
         return {
             "input_ids": masked_ids,
@@ -227,17 +241,9 @@ class TextCorpusMLM:
         attention_mask = ids != PAD
 
         # Identical masking recipe to SyntheticMLM (content = non-special,
-        # which here includes [UNK]).
-        content = ids >= NUM_SPECIAL
-        rr = rng.random(ids.shape)
-        selected = content & (rr < cfg.mask_prob)
-        targets = np.where(selected, ids, -1).astype(np.int32)
-        action = rng.random(ids.shape)
-        masked_ids = ids.copy()
-        masked_ids[selected & (action < 0.8)] = MASK
-        rand_sites = selected & (action >= 0.8) & (action < 0.9)
-        masked_ids[rand_sites] = rng.integers(
-            NUM_SPECIAL_TEXT, self.vocab_size, size=int(rand_sites.sum())
+        # which here includes [UNK]); random replacements draw real words.
+        masked_ids, targets = _apply_bert_masking(
+            rng, ids, cfg.mask_prob, NUM_SPECIAL_TEXT, self.vocab_size
         )
         return {
             "input_ids": masked_ids,

@@ -50,6 +50,13 @@ class BertConfig:
     # attention. With an axis set, the model must run inside shard_map with
     # the sequence dim of all [B, L] inputs sharded over that axis.
     seq_axis: str | None = None
+    # Tensor (model) parallelism: Megatron-style sharding of attention heads
+    # and the FFN hidden dim over ``model_axis`` with ``model_parallel``
+    # shards. Params are created GLOBAL (init with model_parallel=1 config)
+    # and sliced by ``bert_param_specs``; inside shard_map the module builds
+    # local-head/local-FFN projections and psums the row-parallel outputs.
+    model_axis: str | None = None
+    model_parallel: int = 1
     # Single-shard attention implementation: "dense" (XLA-composed) or
     # "flash" (Pallas kernel, ops/flash_attention.py — wins for long L).
     # Ignored when seq_axis is set (the ring has its own blockwise kernel).
@@ -96,7 +103,24 @@ class BertEmbeddings(nn.Module):
         return self.dropout(self.ln(x), deterministic=not train)
 
 
+def _tp_psum(cfg: BertConfig, y):
+    """Sum row-parallel partial outputs across the model axis (no-op tp=1)."""
+    if cfg.model_axis is not None and cfg.model_parallel > 1:
+        return lax.psum(y, cfg.model_axis)
+    return y
+
+
 class BertSelfAttention(nn.Module):
+    """Multi-head attention, Megatron-sharded over ``cfg.model_axis``.
+
+    Column-parallel Q/K/V (each shard projects its ``num_heads /
+    model_parallel`` local heads), attention runs per-head locally (the
+    seq ring composes: each ring step attends the local heads), and the
+    row-parallel output projection psums partial [B,L,H] results. The
+    output bias lives OUTSIDE the projection (``out_bias``) so it is added
+    once, after the psum, not once per shard.
+    """
+
     cfg: BertConfig
 
     @nn.compact
@@ -104,8 +128,9 @@ class BertSelfAttention(nn.Module):
         cfg = self.cfg
         b, l, _ = x.shape
         head_dim = cfg.hidden_size // cfg.num_heads
+        local_heads = cfg.num_heads // cfg.model_parallel
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (cfg.num_heads, head_dim),
+            (local_heads, head_dim),
             dtype=cfg.dtype,
             kernel_init=nn.initializers.normal(0.02),
             name=name,
@@ -122,10 +147,15 @@ class BertSelfAttention(nn.Module):
         out = nn.DenseGeneral(
             cfg.hidden_size,
             axis=(-2, -1),
+            use_bias=False,
             dtype=cfg.dtype,
             kernel_init=nn.initializers.normal(0.02),
             name="out",
         )(ctx)
+        out = _tp_psum(cfg, out)
+        out = out + self.param(
+            "out_bias", nn.initializers.zeros_init(), (cfg.hidden_size,)
+        ).astype(out.dtype)
         out = nn.Dropout(cfg.dropout_rate)(out, deterministic=not train)
         # Post-LN (original BERT): LN over the residual sum.
         return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="ln")(x + out)
@@ -138,8 +168,10 @@ class BertLayer(nn.Module):
     def __call__(self, x, mask, *, train: bool = False):
         cfg = self.cfg
         x = BertSelfAttention(cfg, name="attention")(x, mask, train=train)
+        # Column-parallel up-projection, row-parallel down-projection with
+        # the bias applied post-psum (see BertSelfAttention).
         y = nn.Dense(
-            cfg.intermediate_size,
+            cfg.intermediate_size // cfg.model_parallel,
             dtype=cfg.dtype,
             kernel_init=nn.initializers.normal(0.02),
             name="intermediate",
@@ -147,10 +179,15 @@ class BertLayer(nn.Module):
         y = nn.gelu(y, approximate=False)
         y = nn.Dense(
             cfg.hidden_size,
+            use_bias=False,
             dtype=cfg.dtype,
             kernel_init=nn.initializers.normal(0.02),
             name="output",
         )(y)
+        y = _tp_psum(cfg, y)
+        y = y + self.param(
+            "output_bias", nn.initializers.zeros_init(), (cfg.hidden_size,)
+        ).astype(y.dtype)
         y = nn.Dropout(cfg.dropout_rate)(y, deterministic=not train)
         return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="ln")(x + y)
 
@@ -219,6 +256,44 @@ class BertForPreTraining(nn.Module):
         mlm_logits = self.bert.embeddings.word.attend(h) + self.mlm_bias
         nsp_logits = self.nsp_head(pooled)
         return mlm_logits.astype(jnp.float32), nsp_logits.astype(jnp.float32)
+
+
+def bert_param_specs(params, model_axis: str = "model"):
+    """PartitionSpec tree for Megatron-TP sharding of a BERT param tree.
+
+    Pass the GLOBAL params (init'd with ``model_parallel=1``); returns a
+    matching tree: Q/K/V kernels ``P(None, model, None)`` / biases
+    ``P(model, None)`` (column-parallel over heads), attention-out and FFN
+    down-projection kernels row-parallel, FFN up-projection column-parallel,
+    everything else (embeddings, LayerNorms, post-psum biases, pooler,
+    heads) replicated. Feed to ``place_state``/``make_train_step`` as the
+    param sharding contract (train/step.py).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    rules = (
+        (("query", "kernel"), P(None, model_axis, None)),
+        (("key", "kernel"), P(None, model_axis, None)),
+        (("value", "kernel"), P(None, model_axis, None)),
+        (("query", "bias"), P(model_axis, None)),
+        (("key", "bias"), P(model_axis, None)),
+        (("value", "bias"), P(model_axis, None)),
+        (("out", "kernel"), P(model_axis, None, None)),
+        (("intermediate", "kernel"), P(None, model_axis)),
+        (("intermediate", "bias"), P(model_axis)),
+        (("output", "kernel"), P(model_axis, None)),
+    )
+
+    def spec_for(path) -> P:
+        names = tuple(
+            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+        )
+        for suffix, spec in rules:
+            if names[-len(suffix):] == suffix:
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(lambda p, _: spec_for(p), params)
 
 
 def make_bert_pretraining_loss(model: BertForPreTraining):

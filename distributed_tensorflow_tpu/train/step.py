@@ -54,6 +54,7 @@ def make_train_step(
     mode: str = "sync",
     staleness: int = 0,
     batch_spec: P | None = None,
+    state_specs: "TrainState | None" = None,
     donate: bool = True,
 ):
     """Build the compiled ``train_step(state, batch, rng) -> (state, metrics)``.
@@ -69,6 +70,13 @@ def make_train_step(
       staleness: K for ``mode="stale"``; state must be created with the same K.
       batch_spec: PartitionSpec for batch leaves; default: leading dim over
         the DP axes (replicated along any other mesh axes).
+      state_specs: a :class:`TrainState` pytree of PartitionSpecs for
+        tensor-parallel runs (see :func:`make_state_specs`); default fully
+        replicated. With a ``"model"`` mesh axis, the engine resolves the
+        grad contract per leaf: model-sharded leaves keep their local grad
+        (scaled 1/t for the psum-transpose factor), replicated leaves pmean
+        their partial grads across the model axis — verified against the
+        unsharded model in tests/test_bert_tp.py.
       donate: donate state buffers so params update in place in HBM.
     """
     if mode not in ("sync", "stale"):
@@ -78,6 +86,12 @@ def make_train_step(
     dp_axes = data_axes(mesh)
     if batch_spec is None:
         batch_spec = batch_pspec(mesh)
+    if state_specs is None:
+        state_spec_tree = P()
+        param_specs = None
+    else:
+        state_spec_tree = state_specs
+        param_specs = state_specs.params
 
     def per_device_step(state: TrainState, batch, rng: jax.Array):
         if mode == "stale":
@@ -111,6 +125,31 @@ def make_train_step(
         metrics = dict(metrics)
         metrics["loss"] = loss
 
+        if "model" in mesh.axis_names:
+            # Tensor-parallel grad contract (mirrors the seq contract below,
+            # but per-leaf): forward row-parallel psums transpose to psums
+            # (check_vma=False), so every grad path through the TP branches
+            # carries one factor of t = |model|. Model-sharded leaves hold
+            # their LOCAL slice's grad — scale it 1/t; replicated leaves hold
+            # t x their local partial — pmean sums the partials and removes
+            # the factor in one collective.
+            t = mesh.shape["model"]
+
+            def _fix(g, spec):
+                axes = tuple(
+                    a
+                    for entry in (spec or ())
+                    if entry is not None
+                    for a in ((entry,) if isinstance(entry, str) else tuple(entry))
+                )
+                if "model" in axes:
+                    return g / t
+                return lax.pmean(g, "model")
+
+            if param_specs is None:
+                grads = jax.tree.map(lambda g: lax.pmean(g, "model"), grads)
+            else:
+                grads = jax.tree.map(_fix, grads, param_specs)
         if "seq" in mesh.axis_names:
             # Sequence-parallel contract: the loss_fn must return the
             # *global* scalar on every seq shard (psum its numerator/
@@ -155,7 +194,25 @@ def make_train_step(
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        metrics["grad_norm"] = coll.global_norm(grads)
+        if param_specs is not None and "model" in mesh.axis_names:
+            # Model-sharded leaves hold only this shard's slice: psum their
+            # squared norms over the model axis so grad_norm is the GLOBAL
+            # norm on every shard (out_specs=P() would otherwise surface one
+            # shard's partial value).
+            def _sq(g, spec):
+                s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                axes = tuple(
+                    a
+                    for entry in (spec or ())
+                    if entry is not None
+                    for a in ((entry,) if isinstance(entry, str) else tuple(entry))
+                )
+                return lax.psum(s, "model") if "model" in axes else s
+
+            total = sum(jax.tree.leaves(jax.tree.map(_sq, grads, param_specs)))
+            metrics["grad_norm"] = jnp.sqrt(total)
+        else:
+            metrics["grad_norm"] = coll.global_norm(grads)
 
         new_state = TrainState(
             step=state.step + 1,
@@ -173,8 +230,8 @@ def make_train_step(
     smapped = jax.shard_map(
         per_device_step,
         mesh=mesh,
-        in_specs=(P(), batch_spec, P()),
-        out_specs=(P(), P()),
+        in_specs=(state_spec_tree, batch_spec, P()),
+        out_specs=(state_spec_tree, P()),
         check_vma=False,
     )
     return jax.jit(smapped, donate_argnums=(0,) if donate else ())
@@ -213,10 +270,48 @@ def make_eval_step(
     return jax.jit(smapped)
 
 
-def place_state(state: TrainState, mesh) -> TrainState:
-    """Put a host-built TrainState onto the mesh, replicated.
+def make_state_specs(state: TrainState, tx, param_specs) -> TrainState:
+    """Build the TrainState-of-PartitionSpecs for a sharded-param run.
 
-    (With a ``model`` axis in play, params would get sharded specs instead;
-    replicated is the DP-parity layout — SURVEY.md §2 inventory.)
+    ``param_specs`` is a tree matching ``state.params`` (e.g.
+    ``models.bert.bert_param_specs``). Optimizer slots inherit their param's
+    spec (via ``optax.tree_map_params``); the stale grad ring buffer gets
+    the param spec behind its leading K dim; everything else is replicated.
     """
-    return jax.device_put(state, NamedSharding(mesh, P()))
+    import optax as _optax
+
+    opt_specs = _optax.tree_map_params(
+        tx,
+        lambda _, spec: spec,
+        state.opt_state,
+        param_specs,
+        transform_non_params=lambda _: P(),
+    )
+    buf_specs = None
+    if state.grad_buffer is not None:
+        buf_specs = jax.tree.map(lambda s: P(None, *s), param_specs)
+    return TrainState(
+        step=P(),
+        params=param_specs,
+        opt_state=opt_specs,
+        model_state=jax.tree.map(lambda _: P(), state.model_state),
+        grad_buffer=buf_specs,
+        buffer_index=None if state.buffer_index is None else P(),
+    )
+
+
+def place_state(state: TrainState, mesh, state_specs: TrainState | None = None) -> TrainState:
+    """Put a host-built TrainState onto the mesh.
+
+    Replicated by default (the DP-parity layout — SURVEY.md §2 inventory);
+    pass ``state_specs`` (see :func:`make_state_specs`) to shard params and
+    optimizer slots over a ``model`` axis for tensor parallelism.
+    """
+    if state_specs is None:
+        return jax.device_put(state, NamedSharding(mesh, P()))
+    shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        state_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(state, shardings)

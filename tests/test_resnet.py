@@ -7,6 +7,7 @@ Param-count targets are the published sizes for these architectures
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_tensorflow_tpu.data import (
     device_batches,
@@ -57,6 +58,7 @@ def test_resnet50_shapes_and_params():
     assert logits.shape == (2, 1000)
 
 
+@pytest.mark.slow
 def test_resnet50_bf16_compute():
     model = ResNet50(num_classes=10, dtype=jnp.bfloat16)
     params, model_state = init_model(
@@ -70,6 +72,7 @@ def test_resnet50_bf16_compute():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_resnet20_sync_dp_trains(devices8):
     """ResNet-20 on 8-way sync DP: loss falls, BN stats update & stay replicated.
 
