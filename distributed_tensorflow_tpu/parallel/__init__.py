@@ -13,6 +13,11 @@ from distributed_tensorflow_tpu.parallel.mesh import (  # noqa: F401
     initialize_runtime,
 )
 from distributed_tensorflow_tpu.parallel import collectives  # noqa: F401
+from distributed_tensorflow_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pipeline_param_specs,
+    stack_layer_params,
+)
 from distributed_tensorflow_tpu.parallel.ring_attention import (  # noqa: F401
     dense_attention,
     ring_attention,

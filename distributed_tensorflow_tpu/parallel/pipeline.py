@@ -1,0 +1,123 @@
+"""GPipe-style pipeline parallelism as a compiled SPMD schedule.
+
+The reference has no pipeline parallelism (SURVEY.md §2 parallelism
+inventory: PP "absent"); this module is the TPU-native capability the
+framework adds beyond parity, built the idiomatic way: the whole
+microbatch schedule is ONE traced program — a ``lax.scan`` over ticks whose
+stage hand-off is a ``lax.ppermute`` to the ICI neighbor — so ``jax.grad``
+differentiates straight through it and the reverse pass IS the backward
+pipeline (no hand-written schedule, no per-stage processes like GPipe's
+original implementation).
+
+Layout contract (mirrors the tensor-parallel contract in train/step.py):
+
+- The layer stack's params carry a leading ``[n_layers]`` dim sharded over
+  the ``pipeline`` mesh axis (spec ``P("pipeline", ...)``): stage ``s``
+  holds layers ``[s*L/S, (s+1)*L/S)`` and scans them per tick.
+- Inputs/outputs are replicated across the pipeline axis; the last stage's
+  results are psum-broadcast so every stage returns the same output (the
+  engine's per-leaf grad contract then applies: pipeline-sharded leaves
+  scale 1/S, replicated leaves pmean — tests/test_pipeline.py pins
+  equivalence with the sequential model).
+
+Bubble fraction is the standard GPipe ``(S-1)/(M+S-1)``; pick
+``n_microbatches >= 4*S`` for real runs.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# layer_fn(layer_params, activations) -> activations, applied per layer.
+LayerFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def pipeline_apply(
+    layer_fn: LayerFn,
+    stacked_params: Any,
+    x: jax.Array,
+    *,
+    axis_name: str = "pipeline",
+    n_microbatches: int,
+):
+    """Run a stage-sharded layer stack over ``x`` with GPipe microbatching.
+
+    Args:
+      layer_fn: one layer's forward, ``(params_of_one_layer, h) -> h`` with
+        ``h`` shape-preserving (a transformer block, a residual MLP, ...).
+      stacked_params: this stage's LOCAL slice of the stacked layer params —
+        every leaf has leading dim ``local_layers`` (shard_map in_spec
+        ``P(axis_name, ...)`` delivers it from the global ``[n_layers]``
+        stack).
+      x: the full local batch ``[B, ...]``, replicated across the pipeline
+        axis. ``B`` must divide by ``n_microbatches``.
+      axis_name: bound pipeline mesh axis.
+      n_microbatches: GPipe M; higher M = smaller bubble, smaller per-tick
+        matmuls.
+
+    Returns:
+      ``[B, ...]`` — the stack's output, identical on every stage.
+    """
+    S = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    mb = x.reshape(M, B // M, *x.shape[1:])
+    T = M + S - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def run_stage(h):
+        def body(h, p_one):
+            return layer_fn(p_one, h), None
+
+        h, _ = lax.scan(body, h, stacked_params)
+        return h
+
+    def tick(buf, t):
+        # Stage 0 ingests microbatch t (clamped in the drain phase — those
+        # ticks compute garbage that is never collected); later stages take
+        # the neighbor's value that arrived on the previous tick.
+        inject = lax.dynamic_index_in_dim(
+            mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+        )
+        h_in = jnp.where(stage == 0, inject, buf)
+        h_out = run_stage(h_in)
+        buf_next = lax.ppermute(h_out, axis_name, fwd_perm)
+        return buf_next, h_out
+
+    buf0 = jnp.zeros_like(mb[0])
+    _, outs = lax.scan(tick, buf0, jnp.arange(T))
+    # The last stage emits microbatch j at tick j + (S-1). Collect its M
+    # valid outputs and broadcast them to every stage.
+    outs_last = lax.dynamic_slice_in_dim(outs, S - 1, M, 0)
+    y = lax.psum(
+        jnp.where(stage == S - 1, outs_last, jnp.zeros_like(outs_last)),
+        axis_name,
+    )
+    return y.reshape(B, *x.shape[1:])
+
+
+def stack_layer_params(per_layer_params: list) -> Any:
+    """Stack per-layer param trees into one tree with leading [n_layers].
+
+    The host-side companion of :func:`pipeline_apply`: turn
+    ``[params_layer_0, ..., params_layer_{L-1}]`` (identical structures)
+    into the stacked tree whose leaves get spec ``P("pipeline", ...)``.
+    """
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
+
+
+def pipeline_param_specs(stacked_params, axis_name: str = "pipeline"):
+    """Spec tree for a stacked layer stack: leading dim over the pipeline axis."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(
+        lambda leaf: P(axis_name, *(None,) * (leaf.ndim - 1)), stacked_params
+    )

@@ -46,6 +46,16 @@ from distributed_tensorflow_tpu.train.state import TrainState
 LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[Any, dict]]]
 
 
+def _spec_axes(spec) -> tuple[str, ...]:
+    """Flatten a PartitionSpec's entries into the mesh axis names it uses."""
+    return tuple(
+        a
+        for entry in (spec or ())
+        if entry is not None
+        for a in ((entry,) if isinstance(entry, str) else tuple(entry))
+    )
+
+
 def make_train_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
@@ -70,13 +80,14 @@ def make_train_step(
       staleness: K for ``mode="stale"``; state must be created with the same K.
       batch_spec: PartitionSpec for batch leaves; default: leading dim over
         the DP axes (replicated along any other mesh axes).
-      state_specs: a :class:`TrainState` pytree of PartitionSpecs for
-        tensor-parallel runs (see :func:`make_state_specs`); default fully
-        replicated. With a ``"model"`` mesh axis, the engine resolves the
-        grad contract per leaf: model-sharded leaves keep their local grad
+      state_specs: a :class:`TrainState` pytree of PartitionSpecs for runs
+        with sharded params (see :func:`make_state_specs`); default fully
+        replicated. With a ``"model"`` (tensor-parallel) or ``"pipeline"``
+        (stage-sharded stack) mesh axis, the engine resolves the grad
+        contract per leaf: axis-sharded leaves keep their local grad
         (scaled 1/t for the psum-transpose factor), replicated leaves pmean
-        their partial grads across the model axis — verified against the
-        unsharded model in tests/test_bert_tp.py.
+        their partial grads across that axis — verified against unsharded
+        models in tests/test_bert_tp.py and tests/test_pipeline.py.
       donate: donate state buffers so params update in place in HBM.
     """
     if mode not in ("sync", "stale"):
@@ -125,29 +136,31 @@ def make_train_step(
         metrics = dict(metrics)
         metrics["loss"] = loss
 
-        if "model" in mesh.axis_names:
-            # Tensor-parallel grad contract (mirrors the seq contract below,
-            # but per-leaf): forward row-parallel psums transpose to psums
-            # (check_vma=False), so every grad path through the TP branches
-            # carries one factor of t = |model|. Model-sharded leaves hold
-            # their LOCAL slice's grad — scale it 1/t; replicated leaves hold
-            # t x their local partial — pmean sums the partials and removes
-            # the factor in one collective.
-            t = mesh.shape["model"]
+        for shard_axis in ("model", "pipeline"):
+            if shard_axis not in mesh.axis_names:
+                continue
+            # Param-sharded-axis grad contract (mirrors the seq contract
+            # below, but per-leaf; applies to tensor AND pipeline
+            # parallelism): forward psums over the axis (row-parallel TP
+            # outputs; the pipeline's last-stage output broadcast) transpose
+            # to psums (check_vma=False), so every grad path through the
+            # sharded branches carries one factor of t = |axis|. Sharded
+            # leaves hold their LOCAL slice's grad — scale it 1/t;
+            # replicated leaves hold t x their local partial — pmean sums
+            # the partials and removes the factor in one collective.
+            # Verified against unsharded models in tests/test_bert_tp.py
+            # and tests/test_pipeline.py.
+            t = mesh.shape[shard_axis]
 
-            def _fix(g, spec):
-                axes = tuple(
-                    a
-                    for entry in (spec or ())
-                    if entry is not None
-                    for a in ((entry,) if isinstance(entry, str) else tuple(entry))
-                )
-                if "model" in axes:
+            def _fix(g, spec, axis=shard_axis, t=t):
+                if axis in _spec_axes(spec):
                     return g / t
-                return lax.pmean(g, "model")
+                return lax.pmean(g, axis)
 
             if param_specs is None:
-                grads = jax.tree.map(lambda g: lax.pmean(g, "model"), grads)
+                grads = jax.tree.map(
+                    lambda g, axis=shard_axis: lax.pmean(g, axis), grads
+                )
             else:
                 grads = jax.tree.map(_fix, grads, param_specs)
         if "seq" in mesh.axis_names:
@@ -194,20 +207,21 @@ def make_train_step(
 
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        if param_specs is not None and "model" in mesh.axis_names:
-            # Model-sharded leaves hold only this shard's slice: psum their
-            # squared norms over the model axis so grad_norm is the GLOBAL
-            # norm on every shard (out_specs=P() would otherwise surface one
-            # shard's partial value).
+        shard_axes = tuple(
+            a for a in ("model", "pipeline") if a in mesh.axis_names
+        )
+        if param_specs is not None and shard_axes:
+            # Sharded leaves hold only this shard's slice: psum their
+            # squared norms over the sharding axes so grad_norm is the
+            # GLOBAL norm on every shard (out_specs=P() would otherwise
+            # surface one shard's partial value).
             def _sq(g, spec):
                 s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-                axes = tuple(
-                    a
-                    for entry in (spec or ())
-                    if entry is not None
-                    for a in ((entry,) if isinstance(entry, str) else tuple(entry))
-                )
-                return lax.psum(s, "model") if "model" in axes else s
+                axes = _spec_axes(spec)
+                for ax in shard_axes:
+                    if ax in axes:
+                        s = lax.psum(s, ax)
+                return s
 
             total = sum(jax.tree.leaves(jax.tree.map(_sq, grads, param_specs)))
             metrics["grad_norm"] = jnp.sqrt(total)
@@ -305,7 +319,8 @@ def place_state(state: TrainState, mesh, state_specs: TrainState | None = None) 
 
     Replicated by default (the DP-parity layout — SURVEY.md §2 inventory);
     pass ``state_specs`` (see :func:`make_state_specs`) to shard params and
-    optimizer slots over a ``model`` axis for tensor parallelism.
+    optimizer slots over a ``model`` axis (tensor parallelism) and/or a
+    ``pipeline`` axis (stage-sharded layer stacks, parallel/pipeline.py).
     """
     if state_specs is None:
         return jax.device_put(state, NamedSharding(mesh, P()))

@@ -1,0 +1,176 @@
+"""Pipeline parallelism: GPipe schedule ≡ the sequential layer stack.
+
+The invariant: pipelining is an execution schedule, not a different model —
+forward outputs and full training trajectories must match the sequential
+stack exactly (up to f32 reduction order) on a data x pipeline mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_tensorflow_tpu.data import (
+    device_batches,
+    synthetic_image_classification,
+)
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_param_specs,
+    stack_layer_params,
+)
+from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+from distributed_tensorflow_tpu.train.state import TrainState
+from distributed_tensorflow_tpu.train.step import make_state_specs, place_state
+
+D, L_LAYERS, CLASSES = 16, 8, 10
+
+
+def _layer_fn(p, h):
+    return h + jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _init_params(key):
+    keys = jax.random.split(key, L_LAYERS + 1)
+    per_layer = [
+        {
+            "w": jax.random.normal(keys[i], (D, D)) * 0.3,
+            "b": jnp.zeros((D,)),
+        }
+        for i in range(L_LAYERS)
+    ]
+    head = {
+        "w": jax.random.normal(keys[-1], (D, CLASSES)) * 0.3,
+        "b": jnp.zeros((CLASSES,)),
+    }
+    return jax.device_get({"stack": stack_layer_params(per_layer), "head": head})
+
+
+def _sequential_stack(stacked, h):
+    def body(h, p_one):
+        return _layer_fn(p_one, h), None
+
+    return lax.scan(body, h, stacked)[0]
+
+
+def _make_loss(pipelined: bool, n_microbatches: int = 4):
+    def loss_fn(params, model_state, batch, rng):
+        h = batch["image"].reshape(batch["image"].shape[0], -1)
+        if pipelined:
+            h = pipeline_apply(
+                _layer_fn,
+                params["stack"],
+                h,
+                n_microbatches=n_microbatches,
+            )
+        else:
+            h = _sequential_stack(params["stack"], h)
+        logits = h @ params["head"]["w"] + params["head"]["b"]
+        labels = batch["label"]
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, (model_state, {"accuracy": acc})
+
+    return loss_fn
+
+
+def _specs(params, state, tx):
+    pspecs = {
+        "stack": pipeline_param_specs(params["stack"]),
+        "head": jax.tree.map(lambda _: P(), params["head"]),
+    }
+    return make_state_specs(state, tx, pspecs)
+
+
+def test_pipeline_forward_matches_sequential(devices8):
+    params = _init_params(jax.random.key(0))
+    x = np.random.default_rng(0).normal(size=(16, D)).astype(np.float32)
+    ref = _sequential_stack(params["stack"], jnp.asarray(x))
+
+    mesh = build_mesh({"pipeline": 8})
+    stack_specs = pipeline_param_specs(params["stack"])
+    placed = jax.device_put(
+        params["stack"],
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            stack_specs,
+            is_leaf=lambda v: isinstance(v, P),
+        ),
+    )
+    run = jax.jit(
+        jax.shard_map(
+            lambda p, x: pipeline_apply(_layer_fn, p, x, n_microbatches=4),
+            mesh=mesh,
+            in_specs=(stack_specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    got = run(placed, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=1e-5)
+
+
+def test_pipeline_training_matches_sequential(devices8):
+    params = _init_params(jax.random.key(1))
+    ds = synthetic_image_classification(256, (4, 4, 1), CLASSES, seed=0)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    # Sequential reference on a 2-device DP mesh.
+    mesh_ref = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    state_ref = place_state(create_train_state(params, tx), mesh_ref)
+    step_ref = make_train_step(_make_loss(False), tx, mesh_ref)
+    batches_ref = device_batches(ds, mesh_ref, 32, seed=5)
+
+    # Pipelined: data=2 x pipeline=4, stacked params sharded by stage.
+    mesh_pp = build_mesh({"data": 2, "pipeline": 4})
+    host_state = create_train_state(params, tx)
+    specs = _specs(params, host_state, tx)
+    state_pp = place_state(host_state, mesh_pp, specs)
+    step_pp = make_train_step(
+        _make_loss(True), tx, mesh_pp, state_specs=specs
+    )
+    batches_pp = device_batches(ds, mesh_pp, 32, seed=5)
+
+    rng = jax.random.key(0)
+    for _ in range(3):
+        state_ref, m_ref = step_ref(state_ref, next(batches_ref), rng)
+        state_pp, m_pp = step_pp(state_pp, next(batches_pp), rng)
+
+    assert np.isclose(float(m_ref["loss"]), float(m_pp["loss"]), atol=1e-5), (
+        float(m_ref["loss"]),
+        float(m_pp["loss"]),
+    )
+    assert np.isclose(
+        float(m_ref["grad_norm"]), float(m_pp["grad_norm"]), rtol=1e-4
+    )
+    flat_ref = jax.tree_util.tree_leaves_with_path(jax.device_get(state_ref.params))
+    flat_pp = dict(jax.tree_util.tree_leaves_with_path(jax.device_get(state_pp.params)))
+    for path, leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(leaf),
+            np.asarray(flat_pp[path]),
+            atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pipeline_rejects_bad_microbatch_split(devices8):
+    import pytest
+
+    params = _init_params(jax.random.key(2))
+    mesh = build_mesh({"pipeline": 8})
+    stack_specs = pipeline_param_specs(params["stack"])
+    run = jax.shard_map(
+        lambda p, x: pipeline_apply(_layer_fn, p, x, n_microbatches=5),
+        mesh=mesh,
+        in_specs=(stack_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.jit(run)(params["stack"], jnp.zeros((16, D)))
