@@ -18,6 +18,12 @@ from distributed_tensorflow_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_param_specs,
     stack_layer_params,
 )
+from distributed_tensorflow_tpu.parallel.moe import (  # noqa: F401
+    expert_param_specs,
+    moe_apply,
+    stack_expert_params,
+    switch_route,
+)
 from distributed_tensorflow_tpu.parallel.ring_attention import (  # noqa: F401
     dense_attention,
     ring_attention,

@@ -1,0 +1,147 @@
+"""Mixture-of-experts with expert parallelism over an ``expert`` mesh axis.
+
+The reference has no MoE (SURVEY.md §2 parallelism inventory: EP "absent");
+this module completes the framework's parallelism set (dp/sp/tp/pp/ep)
+the TPU-native way: experts are sharded over the ``expert`` axis (each
+device owns ``n_experts / |axis|`` expert FFNs), tokens are routed
+switch-style (top-1, capacity-bounded, load-balance aux loss), and each
+shard computes ONLY its local experts' tokens — partial outputs psum over
+the axis, so the engine's per-leaf sharded-param grad contract
+(train/step.py: sharded leaves 1/t, replicated pmean) applies unchanged.
+
+Routing is deterministic and identical on every shard (the router is
+replicated), so there is no cross-shard token exchange to disagree about:
+with tokens replicated across the expert axis each shard gathers its own
+experts' tokens locally. (A token-sharded all-to-all dispatch layout is
+the known next optimization for very large token counts; this layout keeps
+routing exact and bandwidth-free on the batch.)
+
+Capacity semantics are the standard Switch Transformer rules: each expert
+processes at most ``capacity = ceil(capacity_factor * N / E)`` tokens, in
+token order; overflow tokens are dropped (their output is 0 — pair MoE
+blocks with residual connections, as transformers do).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# expert_fn(one_expert_params, tokens [C, H]) -> [C, H]
+ExpertFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def switch_route(router_logits: jax.Array, capacity: int):
+    """Top-1 routing with per-expert capacity (Switch Transformer).
+
+    Args:
+      router_logits: ``[N, E]`` (replicated across the expert axis).
+      capacity: max tokens per expert.
+
+    Returns:
+      ``(assign [N], gate [N], slot [N], kept [N], aux)``: chosen expert,
+      its softmax prob, the token's slot within the expert's capacity
+      buffer (valid only where ``kept``), and the scalar load-balance aux
+      loss (Shazeer/Fedus: E * sum_e f_e * p_e).
+    """
+    n, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    assign = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    onehot = jax.nn.one_hot(assign, e, dtype=jnp.float32)
+    # Position of each token within its expert's queue (token order).
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1)  # 1-based
+    kept = pos <= capacity
+    slot = (pos - 1).astype(jnp.int32)
+    frac_tokens = onehot.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return assign, gate, slot, kept, aux
+
+
+def moe_apply(
+    expert_fn: ExpertFn,
+    expert_params_local: Any,
+    router_logits: jax.Array,
+    x: jax.Array,
+    *,
+    axis_name: str | None = "expert",
+    capacity_factor: float = 1.25,
+):
+    """Apply a capacity-bounded top-1 MoE layer, experts sharded over
+    ``axis_name``.
+
+    Args:
+      expert_fn: one expert's forward ``(params, [C, H]) -> [C, H]``.
+      expert_params_local: this shard's slice of the stacked expert params —
+        leading dim ``local_experts`` (shard_map in_spec ``P(axis_name, ...)``
+        from the global ``[n_experts]`` stack; see
+        :func:`expert_param_specs`). With ``axis_name=None`` the stack is
+        the full expert set (single-shard reference semantics).
+      router_logits: ``[N, E_global]`` routing scores (replicated across the
+        expert axis; E_global = n_experts).
+      x: tokens ``[N, H]``, replicated across the expert axis.
+      capacity_factor: capacity = ceil(capacity_factor * N / E_global).
+
+    Returns:
+      ``(y [N, H], aux)`` — gate-weighted expert outputs (0 for dropped
+      tokens; add residually) and the load-balance aux loss scalar.
+    """
+    n, e_global = router_logits.shape
+    local_e = jax.tree.leaves(expert_params_local)[0].shape[0]
+    shards = 1 if axis_name is None else lax.axis_size(axis_name)
+    if local_e * shards != e_global:
+        raise ValueError(
+            f"router has {e_global} experts but shards hold {local_e} x {shards}"
+        )
+    capacity = int(-(-capacity_factor * n // e_global))  # ceil
+    assign, gate, slot, kept, aux = switch_route(router_logits, capacity)
+    first_local = (0 if axis_name is None else lax.axis_index(axis_name)) * local_e
+
+    def one_expert(params_e, e_idx):
+        mine = kept & (assign == e_idx)
+        # Gather this expert's tokens into its capacity buffer. Unfilled
+        # slots point at token 0 with weight 0 (w zeroes them out).
+        token_idx = jnp.zeros((capacity,), jnp.int32)
+        token_idx = token_idx.at[jnp.where(mine, slot, capacity)].set(
+            jnp.arange(n, dtype=jnp.int32), mode="drop"
+        )
+        w = jnp.zeros((capacity,), x.dtype)
+        w = w.at[jnp.where(mine, slot, capacity)].set(
+            gate.astype(x.dtype), mode="drop"
+        )
+        out_c = expert_fn(params_e, x[token_idx]) * w[:, None]
+        # Scatter back to token positions.
+        y = jnp.zeros_like(x)
+        return y.at[token_idx].add(out_c, mode="drop")
+
+    def body(acc, scan_in):
+        params_e, i = scan_in
+        return acc + one_expert(params_e, first_local + i), None
+
+    y, _ = lax.scan(
+        body,
+        jnp.zeros_like(x),
+        (expert_params_local, jnp.arange(local_e)),
+    )
+    if axis_name is not None and shards > 1:
+        y = lax.psum(y, axis_name)
+    return y, aux
+
+
+def stack_expert_params(per_expert_params: list) -> Any:
+    """Stack per-expert param trees into one tree with leading [n_experts]."""
+    from distributed_tensorflow_tpu.parallel.pipeline import stack_layer_params
+
+    return stack_layer_params(per_expert_params)
+
+
+def expert_param_specs(stacked_params, axis_name: str = "expert"):
+    """Spec tree for a stacked expert set: leading dim over the expert axis."""
+    from distributed_tensorflow_tpu.parallel.pipeline import pipeline_param_specs
+
+    return pipeline_param_specs(stacked_params, axis_name)

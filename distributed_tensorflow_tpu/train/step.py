@@ -82,8 +82,8 @@ def make_train_step(
         the DP axes (replicated along any other mesh axes).
       state_specs: a :class:`TrainState` pytree of PartitionSpecs for runs
         with sharded params (see :func:`make_state_specs`); default fully
-        replicated. With a ``"model"`` (tensor-parallel) or ``"pipeline"``
-        (stage-sharded stack) mesh axis, the engine resolves the grad
+        replicated. With a ``"model"`` (tensor-parallel), ``"pipeline"``
+        (stage-sharded stack), or ``"expert"`` (MoE) mesh axis, the engine resolves the grad
         contract per leaf: axis-sharded leaves keep their local grad
         (scaled 1/t for the psum-transpose factor), replicated leaves pmean
         their partial grads across that axis — verified against unsharded
@@ -136,7 +136,7 @@ def make_train_step(
         metrics = dict(metrics)
         metrics["loss"] = loss
 
-        for shard_axis in ("model", "pipeline"):
+        for shard_axis in ("model", "pipeline", "expert"):
             if shard_axis not in mesh.axis_names:
                 continue
             # Param-sharded-axis grad contract (mirrors the seq contract
@@ -208,7 +208,7 @@ def make_train_step(
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         shard_axes = tuple(
-            a for a in ("model", "pipeline") if a in mesh.axis_names
+            a for a in ("model", "pipeline", "expert") if a in mesh.axis_names
         )
         if param_specs is not None and shard_axes:
             # Sharded leaves hold only this shard's slice: psum their
