@@ -155,3 +155,24 @@ def test_resnet50_odd_input_falls_back_to_plain_stem():
     p_even, _ = init_model(model, jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
     p_odd, _ = init_model(model, jax.random.key(0), jnp.zeros((1, 75, 75, 3)))
     assert jax.tree.structure(p_even) == jax.tree.structure(p_odd)
+
+
+def test_pointwise_conv_equals_1x1_conv():
+    """PointwiseConv (the documented dot-form experiment, docs/PERF.md) stays
+    interchangeable with nn.Conv(1x1): same kernel param, same outputs,
+    stride-2 as slice+matmul."""
+    from flax import linen as nn
+
+    from distributed_tensorflow_tpu.models.resnet import PointwiseConv
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 16, 32)), jnp.float32)
+    ref = nn.Conv(32, (1, 1), use_bias=False).apply({"params": {"kernel": k}}, x)
+    got = PointwiseConv(32).apply({"params": {"kernel": k}}, x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=1e-5)
+    ref2 = nn.Conv(32, (1, 1), strides=(2, 2), use_bias=False, padding="VALID").apply(
+        {"params": {"kernel": k}}, x
+    )
+    got2 = PointwiseConv(32, strides=2).apply({"params": {"kernel": k}}, x)
+    np.testing.assert_allclose(np.asarray(ref2), np.asarray(got2), atol=1e-5)
