@@ -472,7 +472,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         if "loss" in metrics:
             metrics["lr"] = float(lr_schedule(step_ - 1))
 
-    hook = make_metric_hook(logdir=args.tb_dir, jsonl=args.metrics_jsonl or None)
+    hook = make_metric_hook(logdir=args.tb_dir, jsonl=args.metrics_jsonl)
     import contextlib
 
     profile_cm = (

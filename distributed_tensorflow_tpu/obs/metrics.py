@@ -55,8 +55,12 @@ def make_metric_hook(
     """Build a ``fit()`` hook writing to TensorBoard and/or JSONL.
 
     Process 0 only; returns a no-op hook elsewhere. The hook signature is
-    the loop's: ``hook(step, state, metrics)``.
+    the loop's: ``hook(step, state, metrics)``. Empty strings count as
+    unset — a default-constructed CLI arg must never create an event file
+    in the current directory.
     """
+    logdir = logdir or None
+    jsonl = jsonl or None
     if jax.process_index() != 0 or (logdir is None and jsonl is None):
         return lambda step, state, metrics: None
     writers = []
