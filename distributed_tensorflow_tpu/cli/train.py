@@ -283,6 +283,22 @@ def _build_bert_workload(cfg_kwargs: dict):
                         vocab_size=init_cfg.vocab_size, seq_len=L, seed=0
                     )
                 )
+            from distributed_tensorflow_tpu.models.bert import make_bert_eval_metrics
+
+            def eval_batches(n_batches: int) -> Iterator[dict]:
+                # Held-out stream: a disjoint seed over the same source (for
+                # real corpora this is fresh sampling/masking, not unseen
+                # text — the honest option without a provided val split).
+                it = mlm_device_batches(
+                    data,
+                    mesh,
+                    cfg.global_batch,
+                    seq_sharded=bool(seq_parallel),
+                    seed=900_001,
+                )
+                for _ in range(n_batches):
+                    yield next(it)
+
             return {
                 "params": variables["params"],
                 "param_specs": (
@@ -301,8 +317,8 @@ def _build_bert_workload(cfg_kwargs: dict):
                 "batch_spec": bert_batch_specs(
                     mesh, seq_sharded=bool(seq_parallel)
                 ),
-                "metric_fn": None,
-                "eval_batches": None,
+                "metric_fn": make_bert_eval_metrics(model),
+                "eval_batches": eval_batches,
             }
 
         return make
@@ -453,7 +469,10 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     evaluate = None
     if args.eval_every and pieces.get("metric_fn") and pieces.get("eval_batches"):
         eval_step = make_eval_step(
-            pieces["metric_fn"], mesh, batch_spec=pieces["batch_spec"]
+            pieces["metric_fn"],
+            mesh,
+            batch_spec=pieces["batch_spec"],
+            state_specs=state_specs,
         )
 
         def evaluate(state):

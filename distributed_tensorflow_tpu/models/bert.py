@@ -258,6 +258,66 @@ class BertForPreTraining(nn.Module):
         return mlm_logits.astype(jnp.float32), nsp_logits.astype(jnp.float32)
 
 
+def _mlm_stats(mlm_logits, batch, seq_axis):
+    """Shared MLM statistics for the train loss and eval metrics: CE sum,
+    masked-token count, and correct count over this shard — psum'd over the
+    seq ring so they are GLOBAL sums (the one masking/clamp/psum recipe both
+    paths must agree on)."""
+    targets = batch["mlm_targets"]
+    weights = (targets >= 0).astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        mlm_logits, jnp.maximum(targets, 0)
+    )
+    num = jnp.sum(ce * weights)
+    den = jnp.sum(weights)
+    correct = jnp.sum(
+        (jnp.argmax(mlm_logits, -1) == targets).astype(jnp.float32) * weights
+    )
+    if seq_axis is not None:
+        num = lax.psum(num, seq_axis)
+        den = lax.psum(den, seq_axis)
+        correct = lax.psum(correct, seq_axis)
+    return num, den, correct
+
+
+def make_bert_eval_metrics(model: BertForPreTraining):
+    """Eval ``metric_fn`` for :func:`make_eval_step`: MLM/NSP losses and
+    accuracies on held-out batches, no dropout, no mutation. MLM entries are
+    ``(num, den)`` pairs so the eval step reduces them as global ratios over
+    the DP axes (variable masked-token counts per shard); seq-parallel
+    handling is shared with the training loss (:func:`_mlm_stats`)."""
+    seq_axis = model.cfg.seq_axis
+
+    def metric_fn(params, model_state, batch):
+        del model_state
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch["attention_mask"],
+            batch["token_type_ids"],
+            train=False,
+        )
+        num, den, correct = _mlm_stats(mlm_logits, batch, seq_axis)
+        b = batch["nsp_label"].shape[0]
+        nsp_ce = optax.softmax_cross_entropy_with_integer_labels(
+            nsp_logits, batch["nsp_label"]
+        ).sum()
+        nsp_correct = (
+            (jnp.argmax(nsp_logits, -1) == batch["nsp_label"])
+            .astype(jnp.float32)
+            .sum()
+        )
+        rows = jnp.asarray(b, jnp.float32)
+        return {
+            "mlm_loss": (num, den),
+            "mlm_accuracy": (correct, den),
+            "nsp_loss": (nsp_ce, rows),
+            "nsp_accuracy": (nsp_correct, rows),
+        }
+
+    return metric_fn
+
+
 def bert_param_specs(params, model_axis: str = "model"):
     """PartitionSpec tree for Megatron-TP sharding of a BERT param tree.
 
@@ -316,20 +376,7 @@ def make_bert_pretraining_loss(model: BertForPreTraining):
             train=True,
             rngs={"dropout": rng},
         )
-        targets = batch["mlm_targets"]
-        weights = (targets >= 0).astype(jnp.float32)
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            mlm_logits, jnp.maximum(targets, 0)
-        )
-        num = jnp.sum(ce * weights)
-        den = jnp.sum(weights)
-        correct = jnp.sum(
-            (jnp.argmax(mlm_logits, -1) == targets).astype(jnp.float32) * weights
-        )
-        if seq_axis is not None:
-            num = lax.psum(num, seq_axis)
-            den = lax.psum(den, seq_axis)
-            correct = lax.psum(correct, seq_axis)
+        num, den, correct = _mlm_stats(mlm_logits, batch, seq_axis)
         den = jnp.maximum(den, 1.0)
         mlm_loss = num / den
         nsp_loss = optax.softmax_cross_entropy_with_integer_labels(

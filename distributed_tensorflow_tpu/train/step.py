@@ -256,28 +256,45 @@ def make_eval_step(
     mesh,
     *,
     batch_spec: P | None = None,
+    state_specs: "TrainState | None" = None,
 ):
-    """Build ``eval_step(state, batch) -> metrics`` (metrics pmean'd over DP).
+    """Build ``eval_step(state, batch) -> metrics`` (metrics reduced over DP).
 
-    ``metric_fn(params, model_state, batch) -> dict`` runs on the shard; the
-    engine averages. The reference had no eval path beyond running the train
-    graph without the train op (SURVEY.md §5) — this is the deliberate
-    do-better (SURVEY.md §4 "Consequence for the rebuild").
+    ``metric_fn(params, model_state, batch) -> dict`` runs on the shard.
+    Plain scalar values are pmean'd across the DP axes. A ``(num, den)``
+    tuple value is reduced as a GLOBAL ratio — psum both then divide — for
+    metrics whose per-shard denominators differ (e.g. MLM loss over a
+    variable number of masked tokens, where an unweighted mean-of-ratios
+    would over-weight sparse shards). ``state_specs`` matches the train
+    step's (sharded params evaluate in their sharded layout — the
+    metric_fn's model must carry the same tp/pp config). The reference had
+    no eval path beyond running the train graph without the train op
+    (SURVEY.md §5) — this is the deliberate do-better (SURVEY.md §4
+    "Consequence for the rebuild").
     """
     dp_axes = data_axes(mesh)
     if batch_spec is None:
         batch_spec = batch_pspec(mesh)
+    state_spec_tree = P() if state_specs is None else state_specs
 
     def per_device_eval(state: TrainState, batch):
         metrics = metric_fn(state.params, state.model_state, batch)
-        if dp_axes:
-            metrics = coll.pmean_tree(dict(metrics), dp_axes)
-        return metrics
+        out = {}
+        for k, v in dict(metrics).items():
+            if isinstance(v, tuple):
+                num, den = v
+                if dp_axes:
+                    num = lax.psum(num, dp_axes)
+                    den = lax.psum(den, dp_axes)
+                out[k] = num / jnp.maximum(den, 1.0)
+            else:
+                out[k] = lax.pmean(v, dp_axes) if dp_axes else v
+        return out
 
     smapped = jax.shard_map(
         per_device_eval,
         mesh=mesh,
-        in_specs=(P(), batch_spec),
+        in_specs=(state_spec_tree, batch_spec),
         out_specs=P(),
         check_vma=False,
     )

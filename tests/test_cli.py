@@ -93,6 +93,28 @@ def test_cli_lr_schedule_and_eval(tmp_path):
     assert all("eval_loss" in r for r in evals)
 
 
+@pytest.mark.slow
+def test_cli_bert_eval_and_tensor_parallel(tmp_path):
+    """BERT eval metrics land in JSONL, under tensor parallelism."""
+    rc = main(
+        [
+            "--config=bert_base",
+            "--steps=4",
+            "--global-batch=16",
+            "--tensor-parallel=4",
+            "--log-every=2",
+            "--eval-every=4",
+            "--eval-batches=1",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    evals = [r for r in lines if "eval_mlm_accuracy" in r]
+    assert evals and {r["step"] for r in evals} == {4}
+    assert all("eval_nsp_accuracy" in r and "eval_mlm_loss" in r for r in evals)
+
+
 def test_cli_resume_does_not_replay_data(tmp_path):
     """A restored run consumes batches N.. — the JSONL of a 4+4 resumed run
     must match an 8-step straight run exactly (same data stream)."""
