@@ -60,6 +60,10 @@ class WorkloadConfig:
     staleness: int = 0
     seq_parallel: int = 0  # >0: seq axis size for ring attention (BERT)
     tensor_parallel: int = 0  # >0: model axis size for Megatron-TP (BERT)
+    moe_experts: int = 0  # >0: switch-MoE FFN with this many experts (BERT)
+    expert_parallel: int = 0  # >0: expert axis size for MoE sharding (BERT)
+    bert_layers: int = 0  # >0: override encoder depth (smoke runs)
+    bert_hidden: int = 0  # >0: override hidden size (intermediate = 4x)
     image_size: int = 0  # overridable per run
     dataset: str = ""  # real-dataset name for data/readers.load_dataset
     data_dir: str = ""  # where to look for it; synthetic fallback otherwise
@@ -237,13 +241,34 @@ def _build_bert_workload(cfg_kwargs: dict):
 
             seq_parallel = cfg.seq_parallel and "seq" in mesh.axis_names
             tp = mesh.shape.get("model", 1)
-            init_cfg = BertConfig(**cfg_kwargs)
+            ep = mesh.shape.get("expert", 1)
+            kwargs = dict(cfg_kwargs)
+            if cfg.bert_layers:
+                kwargs["num_layers"] = cfg.bert_layers
+            if cfg.bert_hidden:
+                kwargs["hidden_size"] = cfg.bert_hidden
+                kwargs["intermediate_size"] = 4 * cfg.bert_hidden
+            init_cfg = BertConfig(**kwargs)
+            if cfg.moe_experts:
+                if cfg.moe_experts % max(ep, 1):
+                    raise ValueError(
+                        f"--moe-experts={cfg.moe_experts} not divisible by "
+                        f"--expert-parallel={ep}"
+                    )
+                # Init with the GLOBAL expert count (expert_parallel=1).
+                init_cfg = dataclasses.replace(
+                    init_cfg, moe_experts=cfg.moe_experts
+                )
             model_cfg = init_cfg
             if seq_parallel:
                 model_cfg = dataclasses.replace(model_cfg, seq_axis="seq")
             if tp > 1:
                 model_cfg = dataclasses.replace(
                     model_cfg, model_axis="model", model_parallel=tp
+                )
+            if ep > 1:
+                model_cfg = dataclasses.replace(
+                    model_cfg, expert_axis="expert", expert_parallel=ep
                 )
             # Init outside shard_map must not bind the seq axis; the param
             # tree is identical either way (tests/test_bert.py).
@@ -302,7 +327,13 @@ def _build_bert_workload(cfg_kwargs: dict):
             return {
                 "params": variables["params"],
                 "param_specs": (
-                    bert_param_specs(variables["params"]) if tp > 1 else None
+                    bert_param_specs(
+                        variables["params"],
+                        model_axis="model" if tp > 1 else None,
+                        expert_axis="expert" if ep > 1 else None,
+                    )
+                    if tp > 1 or ep > 1
+                    else None
                 ),
                 "model_state": {},
                 "loss_fn": make_bert_pretraining_loss(model),
@@ -422,19 +453,36 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         mesh_spec["seq"] = cfg.seq_parallel
     if cfg.tensor_parallel:
         mesh_spec["model"] = cfg.tensor_parallel
+    if cfg.expert_parallel:
+        mesh_spec["expert"] = cfg.expert_parallel
     mesh = build_mesh(mesh_spec)
     if jax.process_index() == 0:
         logging.info("workload=%s mesh=%s", cfg.name, dict(mesh.shape))
 
     pieces = cfg.build(cfg)(mesh)
-    if cfg.tensor_parallel > 1 and pieces.get("param_specs") is None:
-        # A model axis with no param sharding means every group of
-        # tensor_parallel devices computes identical grads — silent N-fold
-        # waste, never what the user asked for.
-        raise ValueError(
-            f"--tensor-parallel={cfg.tensor_parallel} is not supported by "
-            f"workload {cfg.name!r} (no tensor-parallel param sharding)"
+    # A model/expert axis with no param actually sharded over it means every
+    # group of those devices computes identical grads — silent N-fold waste,
+    # never what the user asked for. Check each requested axis appears in at
+    # least one param spec (a non-None but all-replicated tree is just as
+    # wasteful as no tree).
+    for axis, width in (("model", cfg.tensor_parallel), ("expert", cfg.expert_parallel)):
+        if width <= 1:
+            continue
+        specs = pieces.get("param_specs")
+        leaves = (
+            jax.tree_util.tree_leaves(
+                specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+            )
+            if specs is not None
+            else []
         )
+        from distributed_tensorflow_tpu.train.step import _spec_axes
+
+        if not any(axis in _spec_axes(s) for s in leaves):
+            raise ValueError(
+                f"a {width}-way {axis!r} axis was requested but workload "
+                f"{cfg.name!r} shards no params over it"
+            )
     tx, lr_schedule = _make_tx(cfg)
     host_state = create_train_state(
         pieces["params"],
@@ -537,6 +585,14 @@ def main(argv: list[str] | None = None):
                         help="seq axis size for ring attention (BERT)")
     parser.add_argument("--tensor-parallel", type=int, default=-1,
                         help="model axis size for Megatron-TP sharding (BERT)")
+    parser.add_argument("--moe-experts", type=int, default=-1,
+                        help="switch-MoE FFN with N experts (BERT; 0 = dense FFN)")
+    parser.add_argument("--expert-parallel", type=int, default=-1,
+                        help="expert axis size for MoE sharding (BERT)")
+    parser.add_argument("--bert-layers", type=int, default=0,
+                        help="override BERT encoder depth (smoke runs)")
+    parser.add_argument("--bert-hidden", type=int, default=0,
+                        help="override BERT hidden size (intermediate = 4x)")
     parser.add_argument("--staleness", type=int, default=-1)
     parser.add_argument("--lr", type=float, default=0.0)
     parser.add_argument("--lr-schedule", default="",
@@ -574,6 +630,14 @@ def main(argv: list[str] | None = None):
         overrides["seq_parallel"] = args.seq_parallel
     if args.tensor_parallel >= 0:
         overrides["tensor_parallel"] = args.tensor_parallel
+    if args.moe_experts >= 0:
+        overrides["moe_experts"] = args.moe_experts
+    if args.expert_parallel >= 0:
+        overrides["expert_parallel"] = args.expert_parallel
+    if args.bert_layers:
+        overrides["bert_layers"] = args.bert_layers
+    if args.bert_hidden:
+        overrides["bert_hidden"] = args.bert_hidden
     if args.staleness >= 0:
         overrides["staleness"] = args.staleness
         if args.staleness:

@@ -61,6 +61,17 @@ class BertConfig:
     # "flash" (Pallas kernel, ops/flash_attention.py — wins for long L).
     # Ignored when seq_axis is set (the ring has its own blockwise kernel).
     attn_impl: str = "dense"
+    # Mixture-of-experts FFN: > 0 replaces every layer's dense FFN with a
+    # switch-routed MoE of ``moe_experts`` experts (parallel/moe.py). With
+    # ``expert_axis``/``expert_parallel`` set, experts shard over that mesh
+    # axis (params init GLOBAL with expert_parallel=1, sliced by
+    # ``bert_param_specs``). The load-balance aux loss is sown into the
+    # "intermediates" collection; make_bert_pretraining_loss adds it.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    expert_axis: str | None = None
+    expert_parallel: int = 1
 
 
 def bert_base(**overrides) -> BertConfig:
@@ -161,6 +172,74 @@ class BertSelfAttention(nn.Module):
         return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="ln")(x + out)
 
 
+class MoeFfn(nn.Module):
+    """Switch-routed MoE FFN: the expert-parallel alternative to the dense
+    intermediate/output projections (parallel/moe.py does routing/dispatch;
+    this module owns the router and the stacked expert params)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        from distributed_tensorflow_tpu.parallel.moe import moe_apply
+
+        cfg = self.cfg
+        # Unsupported compositions are rejected, not silently mis-trained:
+        # under seq parallelism the aux loss would be a per-shard scalar
+        # (violating the engine's global-loss seq contract and down-scaling
+        # the load-balance gradient by the ring size); under TP the FFN
+        # would run redundantly on every model shard. Both are r3 work.
+        if cfg.seq_axis is not None:
+            raise NotImplementedError(
+                "MoE FFN + sequence parallelism is not supported yet "
+                "(per-shard aux loss would break the seq-grad contract)"
+            )
+        if cfg.model_parallel > 1:
+            raise NotImplementedError(
+                "MoE FFN + tensor parallelism is not supported yet "
+                "(the FFN would compute redundantly on every model shard)"
+            )
+        b, l, h = x.shape
+        ff = cfg.intermediate_size
+        e_local = cfg.moe_experts // cfg.expert_parallel
+        init = nn.initializers.normal(0.02)
+        router = nn.Dense(
+            cfg.moe_experts,
+            use_bias=False,
+            dtype=jnp.float32,
+            kernel_init=init,
+            name="router",
+        )
+        w1 = self.param("experts_w1", init, (e_local, h, ff), jnp.float32)
+        b1 = self.param(
+            "experts_b1", nn.initializers.zeros_init(), (e_local, ff), jnp.float32
+        )
+        w2 = self.param("experts_w2", init, (e_local, ff, h), jnp.float32)
+        b2 = self.param(
+            "experts_b2", nn.initializers.zeros_init(), (e_local, h), jnp.float32
+        )
+
+        def expert_fn(p, tokens):
+            t = nn.gelu(
+                tokens @ p["w1"].astype(cfg.dtype) + p["b1"].astype(cfg.dtype),
+                approximate=False,
+            )
+            return t @ p["w2"].astype(cfg.dtype) + p["b2"].astype(cfg.dtype)
+
+        tokens = x.reshape(b * l, h)
+        logits = router(tokens)
+        y, aux = moe_apply(
+            expert_fn,
+            {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
+            logits,
+            tokens,
+            axis_name=cfg.expert_axis if cfg.expert_parallel > 1 else None,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(b, l, h)
+
+
 class BertLayer(nn.Module):
     cfg: BertConfig
 
@@ -168,26 +247,30 @@ class BertLayer(nn.Module):
     def __call__(self, x, mask, *, train: bool = False):
         cfg = self.cfg
         x = BertSelfAttention(cfg, name="attention")(x, mask, train=train)
-        # Column-parallel up-projection, row-parallel down-projection with
-        # the bias applied post-psum (see BertSelfAttention).
-        y = nn.Dense(
-            cfg.intermediate_size // cfg.model_parallel,
-            dtype=cfg.dtype,
-            kernel_init=nn.initializers.normal(0.02),
-            name="intermediate",
-        )(x)
-        y = nn.gelu(y, approximate=False)
-        y = nn.Dense(
-            cfg.hidden_size,
-            use_bias=False,
-            dtype=cfg.dtype,
-            kernel_init=nn.initializers.normal(0.02),
-            name="output",
-        )(y)
-        y = _tp_psum(cfg, y)
-        y = y + self.param(
-            "output_bias", nn.initializers.zeros_init(), (cfg.hidden_size,)
-        ).astype(y.dtype)
+        if cfg.moe_experts:
+            # MoE FFN (dropped-overflow tokens emit 0 and ride the residual).
+            y = MoeFfn(cfg, name="moe")(x, train=train)
+        else:
+            # Column-parallel up-projection, row-parallel down-projection
+            # with the bias applied post-psum (see BertSelfAttention).
+            y = nn.Dense(
+                cfg.intermediate_size // cfg.model_parallel,
+                dtype=cfg.dtype,
+                kernel_init=nn.initializers.normal(0.02),
+                name="intermediate",
+            )(x)
+            y = nn.gelu(y, approximate=False)
+            y = nn.Dense(
+                cfg.hidden_size,
+                use_bias=False,
+                dtype=cfg.dtype,
+                kernel_init=nn.initializers.normal(0.02),
+                name="output",
+            )(y)
+            y = _tp_psum(cfg, y)
+            y = y + self.param(
+                "output_bias", nn.initializers.zeros_init(), (cfg.hidden_size,)
+            ).astype(y.dtype)
         y = nn.Dropout(cfg.dropout_rate)(y, deterministic=not train)
         return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="ln")(x + y)
 
@@ -318,31 +401,48 @@ def make_bert_eval_metrics(model: BertForPreTraining):
     return metric_fn
 
 
-def bert_param_specs(params, model_axis: str = "model"):
-    """PartitionSpec tree for Megatron-TP sharding of a BERT param tree.
+def bert_param_specs(
+    params,
+    model_axis: str | None = "model",
+    expert_axis: str | None = None,
+):
+    """PartitionSpec tree for Megatron-TP / expert sharding of BERT params.
 
-    Pass the GLOBAL params (init'd with ``model_parallel=1``); returns a
-    matching tree: Q/K/V kernels ``P(None, model, None)`` / biases
-    ``P(model, None)`` (column-parallel over heads), attention-out and FFN
-    down-projection kernels row-parallel, FFN up-projection column-parallel,
-    everything else (embeddings, LayerNorms, post-psum biases, pooler,
-    heads) replicated. Feed to ``place_state``/``make_train_step`` as the
-    param sharding contract (train/step.py).
+    Pass the GLOBAL params (init'd with ``model_parallel=1`` /
+    ``expert_parallel=1``) and the mesh axes actually in use (``None``
+    disables that sharding family — a spec must never name an axis the mesh
+    doesn't have). Returns a matching tree: Q/K/V kernels
+    ``P(None, model, None)`` / biases ``P(model, None)`` (column-parallel
+    over heads), attention-out and FFN down-projection kernels
+    row-parallel, FFN up-projection column-parallel, stacked MoE expert
+    params over the expert axis, everything else (embeddings, LayerNorms,
+    post-psum biases, router, pooler, heads) replicated. Feed to
+    ``place_state``/``make_train_step`` as the param sharding contract
+    (train/step.py).
     """
     from jax.sharding import PartitionSpec as P
 
-    rules = (
-        (("query", "kernel"), P(None, model_axis, None)),
-        (("key", "kernel"), P(None, model_axis, None)),
-        (("value", "kernel"), P(None, model_axis, None)),
-        (("query", "bias"), P(model_axis, None)),
-        (("key", "bias"), P(model_axis, None)),
-        (("value", "bias"), P(model_axis, None)),
-        (("out", "kernel"), P(model_axis, None, None)),
-        (("intermediate", "kernel"), P(None, model_axis)),
-        (("intermediate", "bias"), P(model_axis)),
-        (("output", "kernel"), P(model_axis, None)),
-    )
+    rules = ()
+    if model_axis is not None:
+        rules += (
+            (("query", "kernel"), P(None, model_axis, None)),
+            (("key", "kernel"), P(None, model_axis, None)),
+            (("value", "kernel"), P(None, model_axis, None)),
+            (("query", "bias"), P(model_axis, None)),
+            (("key", "bias"), P(model_axis, None)),
+            (("value", "bias"), P(model_axis, None)),
+            (("out", "kernel"), P(model_axis, None, None)),
+            (("intermediate", "kernel"), P(None, model_axis)),
+            (("intermediate", "bias"), P(model_axis)),
+            (("output", "kernel"), P(model_axis, None)),
+        )
+    if expert_axis is not None:
+        rules += (
+            (("experts_w1",), P(expert_axis, None, None)),
+            (("experts_w2",), P(expert_axis, None, None)),
+            (("experts_b1",), P(expert_axis, None)),
+            (("experts_b2",), P(expert_axis, None)),
+        )
 
     def spec_for(path) -> P:
         names = tuple(
@@ -366,16 +466,23 @@ def make_bert_pretraining_loss(model: BertForPreTraining):
     engine's seq-grad contract (train/step.py).
     """
     seq_axis = model.cfg.seq_axis
+    moe = model.cfg.moe_experts > 0
 
     def loss_fn(params, model_state, batch, rng):
-        mlm_logits, nsp_logits = model.apply(
+        # mutable=["intermediates"] is harmless for dense BERT (nothing is
+        # sown; mods comes back empty) — one apply call for both paths.
+        (mlm_logits, nsp_logits), mods = model.apply(
             {"params": params},
             batch["input_ids"],
             batch["attention_mask"],
             batch["token_type_ids"],
             train=True,
             rngs={"dropout": rng},
+            mutable=["intermediates"],
         )
+        if moe:
+            aux_leaves = jax.tree.leaves(mods["intermediates"])
+            moe_aux = sum(aux_leaves) / len(aux_leaves)
         num, den, correct = _mlm_stats(mlm_logits, batch, seq_axis)
         den = jnp.maximum(den, 1.0)
         mlm_loss = num / den
@@ -388,6 +495,9 @@ def make_bert_pretraining_loss(model: BertForPreTraining):
             "nsp_loss": nsp_loss,
             "mlm_accuracy": correct / den,
         }
+        if moe:
+            loss = loss + model.cfg.moe_aux_weight * moe_aux
+            metrics["moe_aux"] = moe_aux
         return loss, (model_state, metrics)
 
     return loss_fn

@@ -1,0 +1,157 @@
+"""MoE-BERT: switch-MoE FFN inside BertLayer, expert-sharded over "expert".
+
+Invariant: expert parallelism is a layout — the EP-sharded MoE-BERT must
+train identically to the same model with all experts local.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_tensorflow_tpu.data.text import (
+    SyntheticMLM,
+    SyntheticMLMConfig,
+    bert_batch_specs,
+    mlm_device_batches,
+)
+from distributed_tensorflow_tpu.models.bert import (
+    BertConfig,
+    BertForPreTraining,
+    bert_param_specs,
+    make_bert_pretraining_loss,
+)
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+from distributed_tensorflow_tpu.train.step import make_state_specs, place_state
+
+L = 32
+TINY_MOE = dict(
+    vocab_size=96,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    intermediate_size=64,
+    max_position=L,
+    dropout_rate=0.0,
+    moe_experts=8,
+)
+
+
+def _init_global(cfg):
+    variables = BertForPreTraining(cfg).init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )
+    return jax.device_get(variables["params"])
+
+
+def _run(mesh, cfg_model, params, batches, n_steps, state_specs=None):
+    tx = optax.adam(1e-3)
+    state = place_state(create_train_state(params, tx), mesh, state_specs)
+    step = make_train_step(
+        make_bert_pretraining_loss(BertForPreTraining(cfg_model)),
+        tx,
+        mesh,
+        batch_spec=bert_batch_specs(mesh),
+        state_specs=state_specs,
+    )
+    metrics = None
+    for _ in range(n_steps):
+        state, metrics = step(state, next(batches), jax.random.key(1))
+    return state, metrics
+
+
+def test_moe_bert_param_structure():
+    cfg = BertConfig(**TINY_MOE)
+    params = _init_global(cfg)
+    layer0 = params["bert"]["layer_0"]
+    assert "moe" in layer0 and "intermediate" not in layer0
+    assert layer0["moe"]["experts_w1"].shape == (8, 32, 64)
+    assert layer0["moe"]["experts_w2"].shape == (8, 64, 32)
+    assert layer0["moe"]["router"]["kernel"].shape == (32, 8)
+    specs = bert_param_specs(params, model_axis=None, expert_axis="expert")
+    flat = {
+        jax.tree_util.keystr(p): s
+        for p, s in jax.tree_util.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+        )
+    }
+    sharded = [k for k, s in flat.items() if any(a == "expert" for a in s if a)]
+    assert len(sharded) == 8, sorted(sharded)  # 2 layers x (w1,b1,w2,b2)
+    assert all("experts_" in k for k in sharded)
+    assert not any(a == "expert" for a in flat["['bert']['layer_0']['moe']['router']['kernel']"] if a)
+
+
+def test_moe_bert_ep_training_matches_local(devices8):
+    init_cfg = BertConfig(**TINY_MOE)
+    params = _init_global(init_cfg)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+
+    # Reference: all experts local, 2-way DP (matched DP width).
+    mesh_ref = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    b_ref = mlm_device_batches(data, mesh_ref, 16, seed=3)
+    state_ref, m_ref = _run(mesh_ref, init_cfg, params, b_ref, 3)
+    assert "moe_aux" in m_ref and float(m_ref["moe_aux"]) > 0
+
+    # EP: data=2 x expert=4 (2 local experts per shard).
+    mesh_ep = build_mesh({"data": 2, "expert": 4})
+    ep_cfg = dataclasses.replace(
+        init_cfg, expert_axis="expert", expert_parallel=4
+    )
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis=None, expert_axis="expert"),
+    )
+    b_ep = mlm_device_batches(data, mesh_ep, 16, seed=3)
+    state_ep, m_ep = _run(mesh_ep, ep_cfg, params, b_ep, 3, state_specs=specs)
+
+    assert np.isclose(float(m_ref["loss"]), float(m_ep["loss"]), atol=1e-4), (
+        float(m_ref["loss"]),
+        float(m_ep["loss"]),
+    )
+    assert np.isclose(
+        float(m_ref["grad_norm"]), float(m_ep["grad_norm"]), rtol=1e-4
+    )
+    flat_ref = jax.tree_util.tree_leaves_with_path(jax.device_get(state_ref.params))
+    flat_ep = dict(jax.tree_util.tree_leaves_with_path(jax.device_get(state_ep.params)))
+    for path, leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(leaf),
+            np.asarray(flat_ep[path]),
+            atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_unsupported_combinations_fail_loudly(devices8, tmp_path):
+    import pytest
+
+    from distributed_tensorflow_tpu.cli import main
+
+    # expert axis without any expert-sharded params
+    with pytest.raises(ValueError, match="shards no params"):
+        main(["--config=bert_base", "--steps=1", "--global-batch=8",
+              "--expert-parallel=2"])
+
+    # MoE + seq parallelism: rejected at trace time, not mis-trained
+    # (checked on the module: full-model init would trip on the unbound
+    # seq axis in the embeddings first).
+    from distributed_tensorflow_tpu.models.bert import MoeFfn
+
+    x = jnp.zeros((1, 4, 32))
+    cfg = BertConfig(**TINY_MOE, seq_axis="seq")
+    with pytest.raises(NotImplementedError, match="sequence parallelism"):
+        MoeFfn(cfg).init(jax.random.key(0), x)
+
+    # MoE + tensor parallelism: same
+    cfg = BertConfig(**TINY_MOE, model_axis="model", model_parallel=2)
+    with pytest.raises(NotImplementedError, match="tensor parallelism"):
+        MoeFfn(cfg).init(jax.random.key(0), x)

@@ -93,6 +93,29 @@ def test_cli_lr_schedule_and_eval(tmp_path):
     assert all("eval_loss" in r for r in evals)
 
 
+def test_cli_bert_tiny_moe_and_eval(tmp_path):
+    """Smoke-scale BERT overrides: MoE + EP + eval through the entrypoint."""
+    rc = main(
+        [
+            "--config=bert_base",
+            "--steps=4",
+            "--global-batch=16",
+            "--bert-layers=2",
+            "--bert-hidden=48",
+            "--moe-experts=8",
+            "--expert-parallel=4",
+            "--log-every=2",
+            "--eval-every=4",
+            "--eval-batches=1",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert any(r.get("moe_aux", 0) > 0 for r in lines)
+    assert any("eval_mlm_accuracy" in r for r in lines)
+
+
 @pytest.mark.slow
 def test_cli_bert_eval_and_tensor_parallel(tmp_path):
     """BERT eval metrics land in JSONL, under tensor parallelism."""
