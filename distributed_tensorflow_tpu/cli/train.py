@@ -64,6 +64,7 @@ class WorkloadConfig:
     expert_parallel: int = 0  # >0: expert axis size for MoE sharding (BERT)
     bert_layers: int = 0  # >0: override encoder depth (smoke runs)
     bert_hidden: int = 0  # >0: override hidden size (intermediate = 4x)
+    bert_vocab: int = 0  # >0: override vocab size (smoke runs)
     image_size: int = 0  # overridable per run
     dataset: str = ""  # real-dataset name for data/readers.load_dataset
     data_dir: str = ""  # where to look for it; synthetic fallback otherwise
@@ -150,7 +151,13 @@ def _image_batches(cfg, ds, mesh, model_hw, *, train, seed, start_step=0):
     )
 
 
-def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
+def _build_image_workload(
+    model, image_shape, num_classes, n_examples=4096, model_factory=None
+):
+    """``model_factory(cfg, shape)`` (optional) builds the model per-config —
+    for models whose architecture depends on the run geometry (Inception's
+    aux head needs the full 299x299 train-time feature map)."""
+
     def build(cfg: WorkloadConfig):
         from distributed_tensorflow_tpu.data.readers import load_dataset
         from distributed_tensorflow_tpu.train.objectives import (
@@ -162,10 +169,11 @@ def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
         shape = image_shape
         if cfg.image_size:
             shape = (cfg.image_size, cfg.image_size, image_shape[-1])
+        net = model_factory(cfg, shape) if model_factory is not None else model
 
         def make(mesh):
             params, model_state = init_model(
-                model, jax.random.key(0), jnp.zeros((1, *shape), jnp.float32)
+                net, jax.random.key(0), jnp.zeros((1, *shape), jnp.float32)
             )
 
             def load(split):
@@ -206,12 +214,12 @@ def _build_image_workload(model, image_shape, num_classes, n_examples=4096):
             return {
                 "params": params,
                 "model_state": model_state,
-                "loss_fn": make_classification_loss(model),
+                "loss_fn": make_classification_loss(net),
                 "batches": lambda start_step=0: _image_batches(
                     cfg, ds, mesh, shape[:2], train=True, seed=1, start_step=start_step
                 ),
                 "batch_spec": None,
-                "metric_fn": make_classification_metrics(model),
+                "metric_fn": make_classification_metrics(net),
                 "eval_batches": eval_batches,
             }
 
@@ -248,6 +256,8 @@ def _build_bert_workload(cfg_kwargs: dict):
             if cfg.bert_hidden:
                 kwargs["hidden_size"] = cfg.bert_hidden
                 kwargs["intermediate_size"] = 4 * cfg.bert_hidden
+            if cfg.bert_vocab:
+                kwargs["vocab_size"] = cfg.bert_vocab
             init_cfg = BertConfig(**kwargs)
             if cfg.moe_experts:
                 if cfg.moe_experts % max(ep, 1):
@@ -285,23 +295,43 @@ def _build_bert_workload(cfg_kwargs: dict):
             # Real corpus when --data-dir holds *.txt (one sentence per
             # line, blank line between documents — the classic BERT
             # pretraining input); seeded synthetic Markov chains otherwise.
-            txt_files = []
+            # A val/*.txt subdirectory provides genuinely unseen eval text
+            # (tokenized with the TRAIN vocab).
+            txt_files, val_files = [], []
             if cfg.data_dir:
                 from pathlib import Path
 
                 txt_files = sorted(Path(cfg.data_dir).glob("*.txt"))
+                val_files = sorted((Path(cfg.data_dir) / "val").glob("*.txt"))
+            eval_data = None
             if txt_files:
-                data = TextCorpusMLM(
-                    txt_files,
-                    TextCorpusConfig(
-                        seq_len=L, vocab_size=init_cfg.vocab_size, seed=0
-                    ),
+                corpus_cfg = TextCorpusConfig(
+                    seq_len=L, vocab_size=init_cfg.vocab_size, seed=0
                 )
+                data = TextCorpusMLM(txt_files, corpus_cfg)
+                if val_files:
+                    eval_data = TextCorpusMLM(
+                        val_files, corpus_cfg, vocab_from=data
+                    )
+                else:
+                    logger.warning(
+                        "no val/*.txt under %s; eval will RESAMPLE THE "
+                        "TRAINING TEXT with fresh masking (not held-out "
+                        "documents) — provide a val split for a true "
+                        "held-out metric",
+                        cfg.data_dir,
+                    )
             else:
                 if cfg.data_dir:
                     logger.warning(
-                        "no *.txt under %s; FALLING BACK TO SYNTHETIC MLM DATA",
+                        "no *.txt under %s; FALLING BACK TO SYNTHETIC MLM DATA%s",
                         cfg.data_dir,
+                        (
+                            f" (IGNORING {len(val_files)} val/*.txt files — "
+                            "training text must live at the top level)"
+                            if val_files
+                            else ""
+                        ),
                     )
                 data = SyntheticMLM(
                     SyntheticMLMConfig(
@@ -311,11 +341,12 @@ def _build_bert_workload(cfg_kwargs: dict):
             from distributed_tensorflow_tpu.models.bert import make_bert_eval_metrics
 
             def eval_batches(n_batches: int) -> Iterator[dict]:
-                # Held-out stream: a disjoint seed over the same source (for
-                # real corpora this is fresh sampling/masking, not unseen
-                # text — the honest option without a provided val split).
+                # Held-out stream: the val corpus when one exists, else a
+                # disjoint seed over the training source (fresh sampling and
+                # masking — for synthetic data that IS unseen data; for a
+                # real corpus the build-time warning above applies).
                 it = mlm_device_batches(
-                    data,
+                    eval_data if eval_data is not None else data,
                     mesh,
                     cfg.global_batch,
                     seq_sharded=bool(seq_parallel),
@@ -399,10 +430,17 @@ def _presets() -> dict[str, WorkloadConfig]:
         "imagenet_inception_async": WorkloadConfig(
             name="imagenet_inception_async",
             build=_build_image_workload(
-                InceptionV3(dtype=jnp.bfloat16, aux_logits=False),
+                None,
                 (299, 299, 3),
                 1000,
                 n_examples=8192,
+                # Aux classifier on at the canonical 299x299 geometry (the
+                # reference-era Inception-v3 recipe trains main + 0.3*aux);
+                # smaller smoke geometries can't feed the aux head's 5x5
+                # VALID conv, so it gates on the run's image size.
+                model_factory=lambda cfg, shape: InceptionV3(
+                    dtype=jnp.bfloat16, aux_logits=shape[0] >= 299
+                ),
             ),
             global_batch=256,
             num_steps=5000,
@@ -521,17 +559,19 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
             mesh,
             batch_spec=pieces["batch_spec"],
             state_specs=state_specs,
+            return_sums=True,
         )
 
         def evaluate(state):
-            sums: dict[str, float] = {}
-            k = 0
-            for batch in pieces["eval_batches"](args.eval_batches):
-                m = eval_step(state, batch)
-                for key, v in m.items():
-                    sums[key] = sums.get(key, 0.0) + float(v)
-                k += 1
-            return {key: v / max(k, 1) for key, v in sums.items()}
+            # (num, den) sums carry across the whole pass and divide once —
+            # the global ratio, not a mean of per-batch ratios (which would
+            # over-weight batches with few masked tokens).
+            from distributed_tensorflow_tpu.train.step import aggregate_metric_sums
+
+            return aggregate_metric_sums(
+                eval_step(state, batch)
+                for batch in pieces["eval_batches"](args.eval_batches)
+            )
 
     def lr_hook(step_: int, state_, metrics: dict) -> None:
         # Mutates before the writers run (hook order) — `lr` lands in every
@@ -593,6 +633,8 @@ def main(argv: list[str] | None = None):
                         help="override BERT encoder depth (smoke runs)")
     parser.add_argument("--bert-hidden", type=int, default=0,
                         help="override BERT hidden size (intermediate = 4x)")
+    parser.add_argument("--bert-vocab", type=int, default=0,
+                        help="override BERT vocab size (smoke runs)")
     parser.add_argument("--staleness", type=int, default=-1)
     parser.add_argument("--lr", type=float, default=0.0)
     parser.add_argument("--lr-schedule", default="",
@@ -638,6 +680,8 @@ def main(argv: list[str] | None = None):
         overrides["bert_layers"] = args.bert_layers
     if args.bert_hidden:
         overrides["bert_hidden"] = args.bert_hidden
+    if args.bert_vocab:
+        overrides["bert_vocab"] = args.bert_vocab
     if args.staleness >= 0:
         overrides["staleness"] = args.staleness
         if args.staleness:

@@ -151,9 +151,20 @@ class TextCorpusMLM:
     train step don't know which one they're fed.
 
     Vocab layout: 0=[PAD] 1=[CLS] 2=[SEP] 3=[MASK] 4=[UNK], words 5..V-1.
+
+    ``vocab_from``: reuse another corpus's vocabulary instead of building
+    one from these files — a held-out val split must tokenize with the
+    TRAIN vocab (unseen words become [UNK]) or its ids would be meaningless
+    to the model.
     """
 
-    def __init__(self, paths, cfg: TextCorpusConfig):
+    def __init__(
+        self,
+        paths,
+        cfg: TextCorpusConfig,
+        *,
+        vocab_from: "TextCorpusMLM | None" = None,
+    ):
         from collections import Counter
         from pathlib import Path
 
@@ -176,11 +187,16 @@ class TextCorpusMLM:
                 doc_last[-1] = True
         if not sents:
             raise ValueError(f"no sentences found in {list(paths)}")
-        freq = Counter(w for s in sents for w in s)
-        n_words = min(len(freq), cfg.vocab_size - NUM_SPECIAL_TEXT)
-        self.vocab = [w for w, _ in freq.most_common(n_words)]
-        self._ids = {w: NUM_SPECIAL_TEXT + i for i, w in enumerate(self.vocab)}
-        self.vocab_size = NUM_SPECIAL_TEXT + n_words
+        if vocab_from is not None:
+            self.vocab = vocab_from.vocab
+            self._ids = vocab_from._ids
+            self.vocab_size = vocab_from.vocab_size
+        else:
+            freq = Counter(w for s in sents for w in s)
+            n_words = min(len(freq), cfg.vocab_size - NUM_SPECIAL_TEXT)
+            self.vocab = [w for w, _ in freq.most_common(n_words)]
+            self._ids = {w: NUM_SPECIAL_TEXT + i for i, w in enumerate(self.vocab)}
+            self.vocab_size = NUM_SPECIAL_TEXT + n_words
         self._sents = [
             np.asarray([self._ids.get(w, UNK) for w in s], np.int32) for s in sents
         ]

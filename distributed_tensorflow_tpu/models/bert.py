@@ -180,7 +180,7 @@ class MoeFfn(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, mask=None, *, train: bool = False):
         from distributed_tensorflow_tpu.parallel.moe import moe_apply
 
         cfg = self.cfg
@@ -235,6 +235,9 @@ class MoeFfn(nn.Module):
             tokens,
             axis_name=cfg.expert_axis if cfg.expert_parallel > 1 else None,
             capacity_factor=cfg.moe_capacity_factor,
+            # PAD positions must not consume routing capacity or bias the
+            # load-balance aux — only attention-mask-valid tokens route.
+            valid=None if mask is None else mask.reshape(b * l),
         )
         self.sow("intermediates", "moe_aux", aux)
         return y.reshape(b, l, h)
@@ -249,7 +252,7 @@ class BertLayer(nn.Module):
         x = BertSelfAttention(cfg, name="attention")(x, mask, train=train)
         if cfg.moe_experts:
             # MoE FFN (dropped-overflow tokens emit 0 and ride the residual).
-            y = MoeFfn(cfg, name="moe")(x, train=train)
+            y = MoeFfn(cfg, name="moe")(x, mask, train=train)
         else:
             # Column-parallel up-projection, row-parallel down-projection
             # with the bias applied post-psum (see BertSelfAttention).

@@ -241,4 +241,23 @@ def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     n_proc = jax.process_count()
     if global_batch % n_proc:
         raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    if n_proc > 1:
+        # The equal-slice-per-process rule assumes every process owns the
+        # same number of mesh devices (true on uniform TPU slices). On a
+        # job where hosts own unequal shares, each host's slice would no
+        # longer match its addressable shards and
+        # make_array_from_process_local_data would mis-assemble — fail
+        # loudly instead of corrupting batches.
+        counts: dict[int, int] = {}
+        for d in mesh.devices.flat:
+            counts[d.process_index] = counts.get(d.process_index, 0) + 1
+        if len(counts) != n_proc or len(set(counts.values())) > 1:
+            # len(counts) < n_proc: a process owns ZERO mesh devices but
+            # would still be assigned a batch slice — just as mis-assembled
+            # as an uneven split.
+            raise ValueError(
+                "mesh devices are unevenly distributed across processes "
+                f"({counts} over {n_proc} processes); equal per-process "
+                "batch slices require uniform local device counts"
+            )
     return global_batch // n_proc

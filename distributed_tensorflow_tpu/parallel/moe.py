@@ -35,30 +35,44 @@ from jax import lax
 ExpertFn = Callable[[Any, jax.Array], jax.Array]
 
 
-def switch_route(router_logits: jax.Array, capacity: int):
+def switch_route(
+    router_logits: jax.Array, capacity: int, valid: jax.Array | None = None
+):
     """Top-1 routing with per-expert capacity (Switch Transformer).
 
     Args:
       router_logits: ``[N, E]`` (replicated across the expert axis).
       capacity: max tokens per expert.
+      valid: optional ``[N]`` bool — tokens that actually exist (e.g. the
+        attention mask of a padded batch). Invalid tokens are never kept,
+        consume no capacity slots (so pads can't displace real tokens into
+        the dropped-overflow path), and contribute nothing to the
+        load-balance statistics.
 
     Returns:
       ``(assign [N], gate [N], slot [N], kept [N], aux)``: chosen expert,
       its softmax prob, the token's slot within the expert's capacity
       buffer (valid only where ``kept``), and the scalar load-balance aux
-      loss (Shazeer/Fedus: E * sum_e f_e * p_e).
+      loss (Shazeer/Fedus: E * sum_e f_e * p_e, over valid tokens).
     """
     n, e = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
     assign = jnp.argmax(probs, axis=-1)
     gate = jnp.max(probs, axis=-1)
     onehot = jax.nn.one_hot(assign, e, dtype=jnp.float32)
-    # Position of each token within its expert's queue (token order).
+    if valid is not None:
+        onehot = onehot * valid[:, None].astype(jnp.float32)
+    # Position of each token within its expert's queue (token order; invalid
+    # tokens were zeroed out of onehot, so they occupy no position).
     pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1)  # 1-based
-    kept = pos <= capacity
+    kept = (pos > 0) & (pos <= capacity)
     slot = (pos - 1).astype(jnp.int32)
-    frac_tokens = onehot.mean(axis=0)
-    frac_probs = probs.mean(axis=0)
+    n_valid = onehot.sum() if valid is not None else jnp.float32(n)
+    n_valid = jnp.maximum(n_valid, 1.0)
+    frac_tokens = onehot.sum(axis=0) / n_valid
+    if valid is not None:
+        probs = probs * valid[:, None].astype(jnp.float32)
+    frac_probs = probs.sum(axis=0) / n_valid
     aux = e * jnp.sum(frac_tokens * frac_probs)
     return assign, gate, slot, kept, aux
 
@@ -71,6 +85,7 @@ def moe_apply(
     *,
     axis_name: str | None = "expert",
     capacity_factor: float = 1.25,
+    valid: jax.Array | None = None,
 ):
     """Apply a capacity-bounded top-1 MoE layer, experts sharded over
     ``axis_name``.
@@ -86,6 +101,8 @@ def moe_apply(
         expert axis; E_global = n_experts).
       x: tokens ``[N, H]``, replicated across the expert axis.
       capacity_factor: capacity = ceil(capacity_factor * N / E_global).
+      valid: optional ``[N]`` bool of real (non-PAD) tokens; see
+        :func:`switch_route`. Invalid tokens always emit 0.
 
     Returns:
       ``(y [N, H], aux)`` — gate-weighted expert outputs (0 for dropped
@@ -99,7 +116,7 @@ def moe_apply(
             f"router has {e_global} experts but shards hold {local_e} x {shards}"
         )
     capacity = int(-(-capacity_factor * n // e_global))  # ceil
-    assign, gate, slot, kept, aux = switch_route(router_logits, capacity)
+    assign, gate, slot, kept, aux = switch_route(router_logits, capacity, valid)
     first_local = (0 if axis_name is None else lax.axis_index(axis_name)) * local_e
 
     def one_expert(params_e, e_idx):

@@ -257,6 +257,7 @@ def make_eval_step(
     *,
     batch_spec: P | None = None,
     state_specs: "TrainState | None" = None,
+    return_sums: bool = False,
 ):
     """Build ``eval_step(state, batch) -> metrics`` (metrics reduced over DP).
 
@@ -271,6 +272,14 @@ def make_eval_step(
     no eval path beyond running the train graph without the train op
     (SURVEY.md §5) — this is the deliberate do-better (SURVEY.md §4
     "Consequence for the rebuild").
+
+    With ``return_sums=True`` every metric comes back as a ``(num, den)``
+    pair of global sums instead of a ratio (scalars become
+    ``(pmean(v), 1.0)``), so a multi-batch eval loop can carry numerators
+    and denominators across the whole pass and divide ONCE — the same
+    mean-of-ratios bias the per-shard reduction avoids would otherwise
+    reappear at the batch level (variable masked-token counts per batch).
+    Aggregate with :func:`aggregate_metric_sums`.
     """
     dp_axes = data_axes(mesh)
     if batch_spec is None:
@@ -286,9 +295,13 @@ def make_eval_step(
                 if dp_axes:
                     num = lax.psum(num, dp_axes)
                     den = lax.psum(den, dp_axes)
-                out[k] = num / jnp.maximum(den, 1.0)
+                if return_sums:
+                    out[k] = (num, den)
+                else:
+                    out[k] = num / jnp.maximum(den, 1.0)
             else:
-                out[k] = lax.pmean(v, dp_axes) if dp_axes else v
+                val = lax.pmean(v, dp_axes) if dp_axes else v
+                out[k] = (val, jnp.float32(1.0)) if return_sums else val
         return out
 
     smapped = jax.shard_map(
@@ -299,6 +312,23 @@ def make_eval_step(
         check_vma=False,
     )
     return jax.jit(smapped)
+
+
+def aggregate_metric_sums(batch_metrics) -> dict:
+    """Reduce an iterable of ``{k: (num, den)}`` dicts to global ratios.
+
+    The companion of ``make_eval_step(..., return_sums=True)``: numerators
+    and denominators accumulate across the whole eval pass and divide once
+    at the end, so batches with more masked tokens (larger ``den``) weigh
+    proportionally more — the global ratio, not a mean of per-batch ratios.
+    """
+    nums: dict[str, float] = {}
+    dens: dict[str, float] = {}
+    for metrics in batch_metrics:
+        for k, (num, den) in metrics.items():
+            nums[k] = nums.get(k, 0.0) + float(num)
+            dens[k] = dens.get(k, 0.0) + float(den)
+    return {k: nums[k] / max(dens[k], 1e-12) for k in nums}
 
 
 def make_state_specs(state: TrainState, tx, param_specs) -> TrainState:

@@ -299,22 +299,24 @@ class Pipeline {
     bool flip = false;
     if (cfg_.flip) flip = std::uniform_int_distribution<int>(0, 1)(rng) != 0;
     // Bilinear resample crop box -> (oh, ow), align_corners=false convention.
+    // Source coordinates clamp to the CROP WINDOW, not the full image: the
+    // crop is resized in isolation (torchvision/TF RRC convention), so border
+    // output pixels never blend content from outside the sampled box. The
+    // clamp also happens BEFORE floor/frac: an unclamped floor at fy < cy
+    // (upscale at the box's top/left edge) would invert the blend weights.
     const float sy_scale = float(ch_) / oh, sx_scale = float(cw_) / ow;
     for (int y = 0; y < oh; ++y) {
-      // Clamp the source coordinate BEFORE taking floor/frac: an unclamped
-      // floor at fy < 0 (crop box touching the top/left border during
-      // upscale) would invert the blend weights toward the wrong row.
       float fy = (y + 0.5f) * sy_scale - 0.5f + cy;
-      fy = std::max(0.0f, std::min(float(h - 1), fy));
+      fy = std::max(float(cy), std::min(float(cy + ch_ - 1), fy));
       const int y0 = int(fy);
-      const int y1 = std::min(h - 1, y0 + 1);
+      const int y1 = std::min(cy + ch_ - 1, y0 + 1);
       const float wy = fy - y0;
       for (int x = 0; x < ow; ++x) {
         const int xo = flip ? (ow - 1 - x) : x;
         float fx = (x + 0.5f) * sx_scale - 0.5f + cx;
-        fx = std::max(0.0f, std::min(float(w - 1), fx));
+        fx = std::max(float(cx), std::min(float(cx + cw_ - 1), fx));
         const int x0 = int(fx);
-        const int x1 = std::min(w - 1, x0 + 1);
+        const int x1 = std::min(cx + cw_ - 1, x0 + 1);
         const float wx = fx - x0;
         float* d = dst + (int64_t(y) * ow + xo) * c;
         for (int chn = 0; chn < c; ++chn) {

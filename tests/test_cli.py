@@ -98,14 +98,15 @@ def test_cli_bert_tiny_moe_and_eval(tmp_path):
     rc = main(
         [
             "--config=bert_base",
-            "--steps=4",
-            "--global-batch=16",
-            "--bert-layers=2",
-            "--bert-hidden=48",
-            "--moe-experts=8",
-            "--expert-parallel=4",
-            "--log-every=2",
-            "--eval-every=4",
+            "--steps=2",
+            "--global-batch=8",
+            "--bert-layers=1",
+            "--bert-hidden=32",
+            "--bert-vocab=256",
+            "--moe-experts=4",
+            "--expert-parallel=2",
+            "--log-every=1",
+            "--eval-every=2",
             "--eval-batches=1",
             f"--metrics-jsonl={tmp_path}/m.jsonl",
         ]

@@ -29,15 +29,18 @@ def _param_count(params):
 
 def test_resnet20_shapes_and_params():
     model = ResNet20()
-    params, model_state = init_model(
-        model, jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+    variables = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )
     )
-    n = _param_count(params)
+    n = _param_count(variables["params"])
     # He et al. report 0.27M for CIFAR ResNet-20.
     assert 0.26e6 < n < 0.28e6, n
-    assert "batch_stats" in model_state
-    logits = model.apply(
-        {"params": params, **model_state}, jnp.zeros((4, 32, 32, 3)), train=False
+    assert "batch_stats" in variables
+    logits = jax.eval_shape(
+        lambda v: model.apply(v, jnp.zeros((4, 32, 32, 3)), train=False),
+        variables,
     )
     assert logits.shape == (4, 10)
     assert logits.dtype == jnp.float32
@@ -45,15 +48,20 @@ def test_resnet20_shapes_and_params():
 
 def test_resnet50_shapes_and_params():
     model = ResNet50()
-    params, model_state = init_model(
-        model, jax.random.key(0), jnp.zeros((1, 64, 64, 3))
+    # eval_shape: param counting and output-shape checks need no real
+    # initialization/compile (this was the fast suite's slowest unit test).
+    variables = jax.eval_shape(
+        lambda: model.init(
+            jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False
+        )
     )
-    n = _param_count(params)
+    n = _param_count(variables["params"])
     # Canonical torchvision/flax ResNet-50 size: 25,557,032.
     assert abs(n - 25_557_032) < 20_000, n
     # Fully-convolutional body + mean-pool head: works at any input size.
-    logits = model.apply(
-        {"params": params, **model_state}, jnp.zeros((2, 96, 96, 3)), train=False
+    logits = jax.eval_shape(
+        lambda v: model.apply(v, jnp.zeros((2, 96, 96, 3)), train=False),
+        variables,
     )
     assert logits.shape == (2, 1000)
 
@@ -149,12 +157,18 @@ def test_resnet50_odd_input_falls_back_to_plain_stem():
     import jax.numpy as jnp
 
     from distributed_tensorflow_tpu.models import ResNet50
-    from distributed_tensorflow_tpu.train.objectives import init_model
 
     model = ResNet50(num_classes=10)
-    p_even, _ = init_model(model, jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
-    p_odd, _ = init_model(model, jax.random.key(0), jnp.zeros((1, 75, 75, 3)))
+    p_even = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    )["params"]
+    p_odd = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.zeros((1, 75, 75, 3)), train=False)
+    )["params"]
     assert jax.tree.structure(p_even) == jax.tree.structure(p_odd)
+    assert jax.tree.map(lambda a: a.shape, p_even) == jax.tree.map(
+        lambda a: a.shape, p_odd
+    )
 
 
 def test_pointwise_conv_equals_1x1_conv():

@@ -164,3 +164,27 @@ def test_trains_end_to_end(corpus, data_mesh):
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+def test_val_corpus_reuses_train_vocab(corpus, tmp_path):
+    """A held-out split must tokenize with the TRAIN vocab: same word -> same
+    id, unseen words -> [UNK] (ADVICE r2: eval on seen text was the only
+    option; val/*.txt now provides true held-out evaluation)."""
+    train = TextCorpusMLM(corpus, TextCorpusConfig(seq_len=32, seed=0))
+    val_file = tmp_path / "val.txt"
+    val_file.write_text(
+        "the fox sleeps\n"
+        "zyzzyva words are unseen\n"
+    )
+    val = TextCorpusMLM(
+        [val_file], TextCorpusConfig(seq_len=32, seed=0), vocab_from=train
+    )
+    assert val.vocab_size == train.vocab_size
+    assert val._ids is train._ids
+    # Shared words map to the train ids; novel words hit [UNK].
+    the_id = train._ids["the"]
+    sent = val._sents[0]
+    assert sent[0] == the_id
+    assert UNK in val._sents[1]
+    b = val.batch(4, seed=1)
+    assert int(b["input_ids"].max()) < train.vocab_size
