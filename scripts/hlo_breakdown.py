@@ -1,0 +1,253 @@
+"""Join an xprof trace with the compiled HLO: per-conv time, role, efficiency.
+
+The trace names device ops ``fusion.N`` with no shapes; the compiled module
+knows what each fusion computes, and its metadata op_name carries both the
+model path (``BottleneckBlock_3/Conv_1``) and whether the op is backward
+(``transpose(jvp(...))``).  This script rebuilds the ResNet-50 train step
+exactly as scripts/mfu_sweep.py runs it, compiles it, attributes trace device
+time to HLO ops, classifies every convolution as fwd / dgrad / wgrad, and
+prints achieved TF/s per op and per bucket — the table that decides which
+Pallas kernels are worth writing.
+
+Conv FLOPs, uniform across fwd/dgrad/wgrad (verified against all three
+dim_labels forms XLA emits):  2 * prod(output_dims) * window_kh*kw * lhs_f
+where lhs_f is the size of the lhs operand's feature dimension.
+
+    python scripts/hlo_breakdown.py --trace /tmp/mfu_trace_b128 [--batch 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+DTYPE_BYTES = {"bf16": 2, "f32": 4, "f16": 2, "s32": 4, "u8": 1, "pred": 1,
+               "s8": 1, "u32": 4, "f64": 8, "s64": 8, "u64": 8}
+
+SHAPE_RE = re.compile(r"(bf16|f32|f16|s32|u32|u8|s8|pred|f64|s64|u64)\[([\d,]*)\]")
+CONV_RE = re.compile(
+    r"%?([\w\.\-]+) = (bf16|f32)\[([\d,]+)\][^=]*convolution\(%?([\w\.\-]+), "
+    r"%?([\w\.\-]+)\), window={size=(\d+)x(\d+)[^}]*}, "
+    r"dim_labels=(\w+)_(\w+)->(\w+)(?:.*op_name=\"([^\"]*)\")?")
+DEF_RE = re.compile(r"^\s*(?:ROOT )?%?([\w\.\-]+) = (\w+)\[([\d,]*)\]")
+
+
+def shape_bytes(text: str) -> int:
+    total = 0
+    for dt, dims in SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * DTYPE_BYTES[dt]
+    return total
+
+
+def parse_hlo(hlo: str):
+    """instr/fusion name -> {kind, role, layer, flops, bytes, detail}."""
+    # Pass 1: every instruction's result dims (for operand shape lookups).
+    dims_of: dict[str, list[int]] = {}
+    for line in hlo.splitlines():
+        m = DEF_RE.match(line)
+        if m:
+            name, _, dims = m.groups()
+            dims_of[name] = [int(d) for d in dims.split(",") if d]
+
+    # Pass 2: conv facts per *instruction* name.
+    convs: dict[str, dict] = {}
+    for line in hlo.splitlines():
+        m = CONV_RE.search(line)
+        if not m:
+            continue
+        (name, _, out_dims, lhs, _rhs, kh, kw, lhs_spec, rhs_spec, _out_spec,
+         op_name) = m.groups()
+        out = [int(d) for d in out_dims.split(",")]
+        lhs_dims = dims_of.get(lhs)
+        f_pos = lhs_spec.index("f")
+        lhs_f = lhs_dims[f_pos] if lhs_dims and f_pos < len(lhs_dims) else 0
+        flops = 2 * int(np.prod(out)) * int(kh) * int(kw) * lhs_f
+        op_name = op_name or ""
+        bwd = "transpose(jvp" in op_name
+        if not bwd:
+            role = "conv_fwd"
+        elif rhs_spec.endswith("oi") or rhs_spec == "01oi":
+            role = "conv_dgrad"
+        else:
+            role = "conv_wgrad"
+        layer_m = re.search(r"(?:jvp\(ResNet\)\)?/)(.*?)/conv", op_name)
+        layer = layer_m.group(1) if layer_m else op_name[-60:]
+        convs[name] = {"role": role, "layer": layer, "flops": flops,
+                       "out": out, "k": f"{kh}x{kw}"}
+
+    # Pass 3: computation name -> member instruction names, to map fusions to
+    # the convs they contain.
+    comps: dict[str, list[str]] = {}
+    cur = None
+    for line in hlo.splitlines():
+        if line.rstrip().endswith("{") and ("ENTRY" in line or line.startswith("%")):
+            cur = line.split()[0].lstrip("%").split("(")[0]
+            comps[cur] = []
+        elif cur is not None:
+            if line.strip() == "}":
+                cur = None
+            else:
+                m = DEF_RE.match(line)
+                if m:
+                    comps[cur].append(m.group(1))
+
+    # Pass 4: entry fusion instructions -> aggregate facts.
+    info: dict[str, dict] = {}
+    fusion_re = re.compile(r"%?([\w\.\-]+) = .*? fusion\((.*?)\),.*?calls=%?([\w\.\-]+)")
+    for line in hlo.splitlines():
+        s = line.strip()
+        m = fusion_re.search(s)
+        if m:
+            name, operands, called = m.groups()
+            members = comps.get(called, [])
+            role, layer, flops, kdesc, out = "elementwise", "", 0, "", None
+            for mem in members:
+                if mem in convs:
+                    c = convs[mem]
+                    role, layer, kdesc, out = c["role"], c["layer"], c["k"], c["out"]
+                    flops += c["flops"]
+            if role == "elementwise":
+                joined = " ".join(members)
+                if "reduce" in joined:
+                    role = "reduce"
+            info[name] = {"role": role, "layer": layer, "flops": flops,
+                          "k": kdesc, "out": out,
+                          "bytes": shape_bytes(s.split(" fusion(")[0]) + shape_bytes(operands)}
+        elif " = " in s and "convolution(" in s:
+            name = DEF_RE.match(s)
+            if name and name.group(1) in convs:
+                c = convs[name.group(1)]
+                info[name.group(1)] = {**c, "bytes": shape_bytes(s)}
+        elif " select-and-scatter(" in s or " reduce-window(" in s:
+            m2 = DEF_RE.match(s)
+            if m2:
+                info[m2.group(1)] = {"role": "pool", "layer": "", "flops": 0,
+                                     "k": "", "out": None, "bytes": shape_bytes(s)}
+    return info
+
+
+def load_trace(trace_dir: str):
+    paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pid_names.items() if "TPU" in n or "/device" in n.lower()}
+    tot, cnt = defaultdict(float), defaultdict(int)
+    steps = 0
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            nm = e["name"]
+            if nm.startswith("jit_per_device_step"):
+                steps += 1
+                continue
+            if nm.isdigit():  # per-step envelope events
+                continue
+            tot[nm] += e.get("dur", 0)
+            cnt[nm] += 1
+    return tot, cnt, max(steps, 1)
+
+
+def build_hlo(batch: int) -> str:
+    from distributed_tensorflow_tpu.models import ResNet50
+    from distributed_tensorflow_tpu.parallel import collectives as coll
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+    from distributed_tensorflow_tpu.train.objectives import init_model, make_classification_loss
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    mesh = build_mesh({"data": -1})
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((1, 224, 224, 3), jnp.float32))
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = place_state(create_train_state(params, tx, model_state), mesh)
+    step = make_train_step(make_classification_loss(model), tx, mesh)
+    gb = batch * len(jax.devices())
+    batch_arrs = coll.shard_batch(
+        {"image": jnp.zeros((gb, 224, 224, 3), jnp.float32),
+         "label": jnp.zeros((gb,), jnp.int32)}, mesh)
+    return step.lower(state, batch_arrs, jax.random.key(0)).compile().as_text()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default="/tmp/mfu_trace_b128")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--hlo-out", default=None)
+    args = ap.parse_args()
+
+    hlo = build_hlo(args.batch)
+    if args.hlo_out:
+        with open(args.hlo_out, "w") as f:
+            f.write(hlo)
+    info = parse_hlo(hlo)
+    tot, cnt, steps = load_trace(args.trace)
+
+    rows, by_role = [], defaultdict(lambda: [0.0, 0])  # role -> [ms, flops]
+    grand = 0.0
+    for name, us in tot.items():
+        ms = us / 1e3 / steps
+        grand += ms
+        i = info.get(name)
+        role = i["role"] if i else "other"
+        by_role[role][0] += ms
+        by_role[role][1] += (i or {}).get("flops", 0)
+        rows.append((ms, name, i))
+    rows.sort(key=lambda r: -r[0])
+
+    print(f"steps: {steps}; device ms/step total: {grand:.2f}")
+    print(f"\n-- by role (ms/step, achieved TF/s where conv) --")
+    for role, (ms, fl) in sorted(by_role.items(), key=lambda kv: -kv[1][0]):
+        tfs = fl / (ms / 1e3) / 1e12 if fl and ms else 0
+        print(f"  {role:>12}: {ms:7.2f} ms  {100*ms/grand:5.1f}%"
+              + (f"   {tfs:6.1f} TF/s ({100*tfs/197:.0f}% MXU)" if tfs else ""))
+
+    print(f"\n-- top {args.top} ops --")
+    print(f"{'ms/step':>8} {'role':>11} {'TF/s':>6} {'GB/s':>6} {'k':>5}  out / layer")
+    for ms, name, i in rows[: args.top]:
+        if i is None:
+            print(f"{ms:8.3f} {'other':>11} {'':>6} {'':>6} {'':>5}  {name[:80]}")
+            continue
+        tfs = i["flops"] / (ms / 1e3) / 1e12 if i.get("flops") else 0
+        gbs = i["bytes"] / (ms / 1e3) / 1e9 if i.get("bytes") else 0
+        print(f"{ms:8.3f} {i['role']:>11} {tfs:6.1f} {gbs:6.0f} {i.get('k',''):>5}"
+              f"  {str(i.get('out'))[:24]:>24} {i.get('layer','')[:40]} [{name}]")
+
+    # conv roles per layer-group: aggregate stage-level
+    print("\n-- conv time by layer (fwd+dgrad+wgrad, ms/step) --")
+    by_layer = defaultdict(lambda: defaultdict(float))
+    for ms, name, i in rows:
+        if i and i["role"].startswith("conv"):
+            by_layer[i["layer"]][i["role"]] += ms
+    for layer, roles in sorted(by_layer.items(),
+                               key=lambda kv: -sum(kv[1].values()))[:20]:
+        tot_ms = sum(roles.values())
+        parts = " ".join(f"{r.split('_')[1]}={v:.2f}" for r, v in sorted(roles.items()))
+        print(f"  {tot_ms:7.2f} ms  {layer[:46]:<46} {parts}")
+
+
+if __name__ == "__main__":
+    main()

@@ -107,7 +107,9 @@ def main():
             mesh,
         )
         rng = jax.random.key(0)
-        n_steps = 20
+        # 60 steps so the ~130 ms scalar-fetch tunnel round-trip that ends the
+        # window (scripts/roofline.py) inflates per-step time by <2.5 ms.
+        n_steps = 60
         try:
             dt, state = timed(step, state, batch, rng, n_steps)
         except Exception as e:  # OOM etc.
