@@ -87,25 +87,40 @@ def main():
     # TPU platform here, block_until_ready returns before the computation
     # drains, so every timed region ends with a value fetch of a metric that
     # data-depends on the whole donated-state chain — that is a true barrier.
-    for _ in range(3):
-        state, metrics = step(state, batch, rng)
-    float(metrics["loss"])
+    def window(n_steps):
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, batch, rng)
+        float(metrics["loss"])
+        return time.perf_counter() - t0
 
-    n_steps = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, metrics = step(state, batch, rng)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    window(3)
 
-    images_per_sec = n_steps * global_batch / dt
-    images_per_sec_chip = images_per_sec / n
+    # Measurement discipline (VERDICT r2 Weak #2 + scripts/roofline.py):
+    # the scalar fetch ending a window costs a ~130 ms tunnel round-trip,
+    # so a single 20-step window overstates step time by ~6.5 ms (r2 did
+    # exactly that). Run >=3 long windows plus short ones; the median
+    # difference cancels the round-trip, and the spread is reported.
+    n_long, n_short = (60, 1) if on_tpu else (3, 1)
+    reps = 3
+    longs = sorted(window(n_long) for _ in range(reps))
+    shorts = sorted(window(n_short) for _ in range(reps))
+    per_step = (longs[reps // 2] - shorts[reps // 2]) / (n_long - n_short)
+    spread = (longs[-1] - longs[0]) / longs[reps // 2]
+
+    images_per_sec_chip = global_batch / per_step / n
     # MFU accounting is defined for the 224x224 workload; scale FLOPs if the
     # CPU-smoke path shrank the image (conv FLOPs ~ HW^2).
     flops_per_image = FLOPS_PER_IMAGE * (image_hw / 224) ** 2
     peak, known = chip_peak_flops(devices[0])
     mfu = images_per_sec_chip * flops_per_image / peak
     peak_note = f"peak={peak / 1e12:.0f}T" + ("" if known else " ASSUMED")
+    # Ceiling context (docs/PERF.md r3 "measured roofline"): this model's
+    # arithmetic intensity (~90 flops/byte at ideal traffic) x the chip's
+    # measured ~650 GB/s HBM bandwidth caps MFU at ~0.30 on a v5e —
+    # the 0.55 target presumes a bandwidth/FLOP ratio this chip lacks.
+    ceil_note = "meas-roofline-ceiling~0.30" if on_tpu else "cpu-smoke"
     print(
         json.dumps(
             {
@@ -113,7 +128,8 @@ def main():
                 "value": round(images_per_sec_chip, 2),
                 "unit": f"images/sec/chip (bf16, b={per_chip_batch}/chip, "
                 f"{image_hw}x{image_hw}, {n}x {devices[0].device_kind}, "
-                f"mfu={mfu:.3f}, {peak_note})",
+                f"mfu={mfu:.3f}, median of {reps}x{n_long}-step windows, "
+                f"spread={spread:.1%}, {peak_note}, {ceil_note})",
                 "vs_baseline": round(mfu / 0.55, 4),
             }
         )

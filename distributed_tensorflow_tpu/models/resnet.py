@@ -37,21 +37,22 @@ ModuleDef = Callable[..., nn.Module]
 
 
 class PointwiseConv(nn.Module):
-    """1x1 convolution expressed as an explicit MXU matmul.
+    """1x1 convolution as an explicit MXU matmul, optionally Pallas-backed.
 
     Mathematically identical to ``nn.Conv(features, (1, 1))`` (same
     ``kernel`` param name/shape, so param trees and checkpoints are
-    interchangeable), but written as ``dot`` instead of
-    ``conv_general_dilated``. A strided 1x1 conv reads only the top-left
-    pixel of each stride window, so ``strides=2`` is exactly a spatial
-    slice followed by the matmul.
+    interchangeable). A strided 1x1 conv reads only the top-left pixel of
+    each stride window, so ``strides=2`` is exactly a spatial slice
+    followed by the matmul.
 
-    Measured r2 outcome (docs/PERF.md): XLA:TPU canonicalizes this back
-    into a rank-2 convolution and the full-model step time is unchanged —
-    the 1x1 layers are HBM-bandwidth-bound, not op-form-bound (a Pallas
-    matmul on the same shapes was no faster). Kept as the documented
-    experiment and for call sites that want the slice+matmul stride form;
-    the ResNet/Inception blocks use ``nn.Conv``.
+    ``backend="dot"`` is the r2 experiment: XLA:TPU canonicalizes the dot
+    back into convolution HLO and the full-model step is unchanged
+    (docs/PERF.md "dead ends").  ``backend="pallas"`` is the r3 fix: the
+    forward stays an XLA dot (its fused BN+ReLU producer chain already
+    saturates bandwidth) but the backward is a ``jax.custom_vjp`` calling
+    Pallas matmul kernels, which XLA *cannot* re-canonicalize — this is
+    what rescues the 8-25 TF/s dgrad/wgrad convs in the trace
+    (ops/pointwise_conv.py).
     """
 
     features: int
@@ -59,9 +60,12 @@ class PointwiseConv(nn.Module):
     use_bias: bool = False
     dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.he_normal()
+    backend: str = "dot"  # "dot" | "pallas"
 
     @nn.compact
     def __call__(self, x):
+        from distributed_tensorflow_tpu.ops.pointwise_conv import pointwise_matmul
+
         s = self.strides if isinstance(self.strides, int) else self.strides[0]
         if s > 1:
             x = x[:, ::s, ::s, :]
@@ -69,16 +73,26 @@ class PointwiseConv(nn.Module):
         kernel = self.param(
             "kernel", self.kernel_init, (1, 1, cin, self.features), jnp.float32
         )
-        # Explicit 2D matmul: an einsum over [B,H,W,C] gets canonicalized
-        # back to a 1x1 convolution by XLA (verified on the r2 HLO — 0 dots,
-        # 161 convs), so flatten the spatial dims first. The reshapes are
-        # layout-preserving (C stays minormost) and the dot — including its
-        # tall-skinny wgrad transpose — stays on the matmul path.
+        # Flattening the spatial dims is layout-preserving (C stays
+        # minormost); with backend="dot" XLA canonicalizes the dot back to a
+        # 1x1 convolution anyway (verified on the r2 HLO), with
+        # backend="pallas" the custom-vjp boundary prevents exactly that for
+        # the backward ops.
         b, h, w, _ = x.shape
-        y = jnp.dot(
-            x.astype(self.dtype).reshape(b * h * w, cin),
-            kernel[0, 0].astype(self.dtype),
-        ).reshape(b, h, w, self.features)
+        k2 = kernel[0, 0].astype(self.dtype)
+        if self.backend == "pallas":
+            # Flatten in H,W,B,C order: XLA:TPU's layout assignment places
+            # these conv activations as {3,0,2,1} (physically H,W,B,C), so
+            # this transpose+reshape lowers to a bitcast at the Pallas
+            # boundary — flattening in B,H,W,C order instead forces a
+            # materialized relayout copy per call (measured +18 ms/step on
+            # the b=128 ResNet-50 trace).
+            x2 = x.astype(self.dtype).transpose(1, 2, 0, 3).reshape(h * w * b, cin)
+            y = pointwise_matmul(x2, k2)
+            y = y.reshape(h, w, b, self.features).transpose(2, 0, 1, 3)
+        else:
+            x2 = x.astype(self.dtype).reshape(b * h * w, cin)
+            y = jnp.dot(x2, k2).reshape(b, h, w, self.features)
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
@@ -115,27 +129,42 @@ class BasicBlock(nn.Module):
 
 
 class BottleneckBlock(nn.Module):
-    """1x1 down / 3x3 / 1x1 up (x4) bottleneck block (ImageNet ResNets)."""
+    """1x1 down / 3x3 / 1x1 up (x4) bottleneck block (ImageNet ResNets).
+
+    ``conv1x1`` (when set) handles the three pointwise convs — the ResNet
+    wires :class:`PointwiseConv` with the Pallas backward here on TPU.
+    Explicit layer names keep the param tree identical to the historical
+    auto-named ``nn.Conv`` layout (Conv_0/Conv_1/Conv_2/proj), so
+    checkpoints are interchangeable across backends.
+    """
 
     filters: int
     strides: int = 1
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = nn.BatchNorm
+    conv1x1: ModuleDef | None = None
+
+    def _c1(self, features: int, strides: int = 1, name: str | None = None):
+        if self.conv1x1 is not None:
+            return self.conv1x1(features, strides=strides, name=name)
+        return self.conv(features, (1, 1), strides=(strides,) * 2, name=name)
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        y = self._c1(self.filters, name="Conv_0")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2)(y)
+        y = self.conv(
+            self.filters, (3, 3), strides=(self.strides,) * 2, name="Conv_1"
+        )(y)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self._c1(self.filters * 4, name="Conv_2")(y)
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
-            residual = self.conv(
-                self.filters * 4, (1, 1), strides=(self.strides,) * 2, name="proj"
+            residual = self._c1(
+                self.filters * 4, strides=self.strides, name="proj"
             )(residual)
             residual = self.norm(name="proj_bn")(residual)
         return nn.relu(y + residual)
@@ -207,6 +236,15 @@ class ResNet(nn.Module):
     stem: str = "imagenet"
     stem_s2d: bool = True
     remat: bool = False  # rematerialize blocks: trade (cheap) FLOPs for HBM
+    # 1x1-conv path: "conv" (default) = nn.Conv everywhere — measured
+    # fastest at the step level. "pallas" = custom-vjp 1x1s with Pallas
+    # dgrad kernels (ops/pointwise_conv.py): 3-5x faster per-op on K>=128
+    # shapes but a net step-level LOSS (56.5 vs 47.9 ms/step at b=128),
+    # because breaking the graph un-fuses XLA's relu/BN-backward epilogues
+    # from the surrounding convs — the full study is in docs/PERF.md r3.
+    # Kept as a benchmarked option and the substrate for future fused
+    # (conv+BN+relu)-backward kernels.
+    pw_backend: str = "conv"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -250,12 +288,23 @@ class ResNet(nn.Module):
             x = nn.relu(x)
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
+        use_pallas = self.pw_backend == "pallas"
+        conv1x1 = (
+            partial(PointwiseConv, dtype=self.dtype, backend="pallas")
+            if use_pallas and self.block is BottleneckBlock
+            else None
+        )
         block_cls = nn.remat(self.block) if self.remat else self.block
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
+                kwargs = {"conv1x1": conv1x1} if self.block is BottleneckBlock else {}
                 x = block_cls(
-                    self.num_filters * 2**i, strides=strides, conv=conv, norm=norm
+                    self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    **kwargs,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         # Head computes in f32: the logits/loss edge is where bf16 hurts.

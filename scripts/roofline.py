@@ -63,7 +63,7 @@ def device_loop(body, k):
     return run
 
 
-def per_iter(body, args, est_iter_sec, target_sec=1.5):
+def per_iter(body, args, est_iter_sec, target_sec=1.5, repeats=5):
     """Seconds per body() iteration, tunnel round-trip cancelled.
 
     The scalar fetch that ends a window costs a ~110 ms tunnel round-trip
@@ -76,8 +76,8 @@ def per_iter(body, args, est_iter_sec, target_sec=1.5):
     """
     for _ in range(2):
         k = max(8, int(target_sec / est_iter_sec)) & ~1
-        t_k = run_window(device_loop(body, k), args)
-        t_half = run_window(device_loop(body, k // 2), args)
+        t_k = run_window(device_loop(body, k), args, repeats=repeats)
+        t_half = run_window(device_loop(body, k // 2), args, repeats=repeats)
         sec = max(t_k - t_half, 1e-9) / (k // 2)
         if 0.25 * target_sec < t_k - t_half < 4 * target_sec:
             break
