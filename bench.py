@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -48,6 +49,14 @@ def chip_peak_flops(device) -> tuple[float, bool]:
 
 
 def main():
+    if os.environ.get("BENCH_WORKLOAD") == "bert":
+        # Transformer workload number (BASELINE.json:11): same driver
+        # protocol, selected by env so the default line stays ResNet-50.
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        import bench_bert
+
+        bench_bert.driver_line()
+        return
     from distributed_tensorflow_tpu.data import synthetic_image_classification
     from distributed_tensorflow_tpu.models import ResNet50
     from distributed_tensorflow_tpu.parallel import collectives as coll

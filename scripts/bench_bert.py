@@ -1,0 +1,149 @@
+"""BERT-base pretraining throughput + MFU on the real chip (VERDICT r2 #3).
+
+The reference's transformer workload (BASELINE.json:11) gets its own
+number: full MLM+NSP train step (fwd+bwd+psum+AdamW), bf16 compute,
+synthetic token batches, at L=128 and L=512. Matmul-dominated, so this also
+bounds how much of the ResNet-50 MFU gap is conv/BN-specific vs framework
+overhead (docs/PERF.md r3: ResNet's ceiling is HBM-bandwidth ~0.30; BERT's
+arithmetic intensity is far higher, so its MFU should approach the MXU
+roofline if the framework isn't the problem).
+
+FLOPs accounting (exact matmul inventory per token, fwd; train = 3x):
+  per layer: QKVO 8d^2 + FFN 4*d*ff;  attention 4*L*d
+  heads: MLM transform 2d^2 + tied decoder 2*d*V  (computed at every
+  position, as the model does)
+Embedding lookups/LayerNorms/softmax excluded (not matmuls) — consistent
+with the standard 6ND convention, making the reported MFU mildly
+conservative.
+
+    python scripts/bench_bert.py            # both geometries
+    BENCH_WORKLOAD=bert python bench.py     # driver-compatible single line
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+PEAK = 197e12  # v5e bf16 (bench.py chip table)
+
+
+def train_flops_per_token(cfg, L: int) -> float:
+    d, ff, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    per_layer = 8 * d * d + 4 * d * ff + 4 * L * d
+    fwd = cfg.num_layers * per_layer + 2 * d * d + 2 * d * V
+    return 3.0 * fwd
+
+
+def bench_config(L: int, per_chip_batch: int, n_long: int = 40) -> dict:
+    from distributed_tensorflow_tpu.models.bert import (
+        BertForPreTraining,
+        bert_base,
+        make_bert_pretraining_loss,
+    )
+    from distributed_tensorflow_tpu.parallel import collectives as coll
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    mesh = build_mesh({"data": -1})
+    n = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        per_chip_batch, n_long = 4, 3
+    gb = per_chip_batch * n
+
+    cfg = bert_base(dtype=jnp.bfloat16, max_position=max(512, L))
+    model = BertForPreTraining(cfg)
+    rng0 = np.random.default_rng(0)
+    ids = rng0.integers(0, cfg.vocab_size, size=(gb, L)).astype(np.int32)
+    mlm_targets = np.where(
+        rng0.random((gb, L)) < 0.15,
+        rng0.integers(0, cfg.vocab_size, size=(gb, L)),
+        -1,
+    ).astype(np.int32)
+    batch = coll.shard_batch(
+        {
+            "input_ids": ids,
+            "attention_mask": np.ones((gb, L), np.int32),
+            "token_type_ids": np.zeros((gb, L), np.int32),
+            "mlm_targets": mlm_targets,
+            "nsp_label": rng0.integers(0, 2, size=(gb,)).astype(np.int32),
+        },
+        mesh,
+    )
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), jnp.int32),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )["params"]
+    tx = optax.adamw(1e-4, weight_decay=0.01)
+    state = place_state(create_train_state(params, tx, {}), mesh)
+    step = make_train_step(make_bert_pretraining_loss(model), tx, mesh)
+    rng = jax.random.key(0)
+
+    def window(k):
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(k):
+            state, metrics = step(state, batch, rng)
+        float(metrics["loss"])
+        return time.perf_counter() - t0
+
+    window(3)  # compile + warm
+    reps = 3
+    longs = sorted(window(n_long) for _ in range(reps))
+    shorts = sorted(window(1) for _ in range(reps))
+    per_step = (longs[reps // 2] - shorts[reps // 2]) / (n_long - 1)
+    spread = (longs[-1] - longs[0]) / longs[reps // 2]
+
+    tokens_per_sec_chip = gb * L / per_step / n
+    mfu = tokens_per_sec_chip * train_flops_per_token(cfg, L) / PEAK
+    return {
+        "L": L,
+        "per_chip_batch": per_chip_batch,
+        "ms_per_step": round(per_step * 1e3, 2),
+        "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 0),
+        "mfu": round(mfu, 4),
+        "spread": round(spread, 4),
+    }
+
+
+def main():
+    results = [bench_config(128, 128), bench_config(512, 24)]
+    for r in results:
+        print(json.dumps(r))
+    return results
+
+
+def driver_line():
+    """One-line JSON for the driver protocol (bench.py BENCH_WORKLOAD=bert)."""
+    r = bench_config(512, 24)
+    dev = jax.devices()[0]
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_train_tokens_per_sec_per_chip",
+                "value": r["tokens_per_sec_per_chip"],
+                "unit": f"tokens/sec/chip (bf16, L=512, b={r['per_chip_batch']}/chip, "
+                f"{dev.device_kind}, mfu={r['mfu']:.3f}, median windows, "
+                f"spread={r['spread']:.1%}, peak=197T)",
+                "vs_baseline": round(r["mfu"] / 0.55, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
