@@ -62,6 +62,8 @@ class WorkloadConfig:
     tensor_parallel: int = 0  # >0: model axis size for Megatron-TP (BERT)
     moe_experts: int = 0  # >0: switch-MoE FFN with this many experts (BERT)
     expert_parallel: int = 0  # >0: expert axis size for MoE sharding (BERT)
+    pipeline_parallel: int = 0  # >0: pipeline axis size, stage-sharded encoder (BERT)
+    pipeline_microbatches: int = 0  # GPipe M; 0 -> 4 * pipeline_parallel
     bert_layers: int = 0  # >0: override encoder depth (smoke runs)
     bert_hidden: int = 0  # >0: override hidden size (intermediate = 4x)
     bert_vocab: int = 0  # >0: override vocab size (smoke runs)
@@ -250,6 +252,7 @@ def _build_bert_workload(cfg_kwargs: dict):
             seq_parallel = cfg.seq_parallel and "seq" in mesh.axis_names
             tp = mesh.shape.get("model", 1)
             ep = mesh.shape.get("expert", 1)
+            pp = mesh.shape.get("pipeline", 1)
             kwargs = dict(cfg_kwargs)
             if cfg.bert_layers:
                 kwargs["num_layers"] = cfg.bert_layers
@@ -279,6 +282,29 @@ def _build_bert_workload(cfg_kwargs: dict):
             if ep > 1:
                 model_cfg = dataclasses.replace(
                     model_cfg, expert_axis="expert", expert_parallel=ep
+                )
+            if pp > 1:
+                # Per-DP-shard rows must split into the GPipe microbatches.
+                dp = mesh.shape.get("data", 1) * mesh.shape.get("replica", 1)
+                micro = cfg.pipeline_microbatches or 4 * pp
+                rows = cfg.global_batch // dp
+                if rows % micro:
+                    raise ValueError(
+                        f"per-shard batch {rows} (global {cfg.global_batch} / "
+                        f"dp {dp}) not divisible by pipeline_microbatches "
+                        f"{micro}"
+                    )
+                # Init config gets pipeline_parallel (stacked params, axis
+                # unset so init runs the sequential scan outside shard_map);
+                # the training model additionally binds the mesh axis.
+                init_cfg = dataclasses.replace(
+                    init_cfg, pipeline_parallel=pp, pipeline_microbatches=micro
+                )
+                model_cfg = dataclasses.replace(
+                    model_cfg,
+                    pipeline_axis="pipeline",
+                    pipeline_parallel=pp,
+                    pipeline_microbatches=micro,
                 )
             # Init outside shard_map must not bind the seq axis; the param
             # tree is identical either way (tests/test_bert.py).
@@ -362,8 +388,9 @@ def _build_bert_workload(cfg_kwargs: dict):
                         variables["params"],
                         model_axis="model" if tp > 1 else None,
                         expert_axis="expert" if ep > 1 else None,
+                        pipeline_axis="pipeline" if pp > 1 else None,
                     )
-                    if tp > 1 or ep > 1
+                    if tp > 1 or ep > 1 or pp > 1
                     else None
                 ),
                 "model_state": {},
@@ -493,6 +520,8 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         mesh_spec["model"] = cfg.tensor_parallel
     if cfg.expert_parallel:
         mesh_spec["expert"] = cfg.expert_parallel
+    if cfg.pipeline_parallel:
+        mesh_spec["pipeline"] = cfg.pipeline_parallel
     mesh = build_mesh(mesh_spec)
     if jax.process_index() == 0:
         logging.info("workload=%s mesh=%s", cfg.name, dict(mesh.shape))
@@ -503,7 +532,11 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     # never what the user asked for. Check each requested axis appears in at
     # least one param spec (a non-None but all-replicated tree is just as
     # wasteful as no tree).
-    for axis, width in (("model", cfg.tensor_parallel), ("expert", cfg.expert_parallel)):
+    for axis, width in (
+        ("model", cfg.tensor_parallel),
+        ("expert", cfg.expert_parallel),
+        ("pipeline", cfg.pipeline_parallel),
+    ):
         if width <= 1:
             continue
         specs = pieces.get("param_specs")
@@ -627,6 +660,11 @@ def main(argv: list[str] | None = None):
                         help="model axis size for Megatron-TP sharding (BERT)")
     parser.add_argument("--moe-experts", type=int, default=-1,
                         help="switch-MoE FFN with N experts (BERT; 0 = dense FFN)")
+    parser.add_argument("--pipeline-parallel", type=int, default=-1,
+                        help="pipeline-stage axis size for the BERT encoder "
+                        "(GPipe schedule; 0 disables)")
+    parser.add_argument("--pipeline-microbatches", type=int, default=0,
+                        help="GPipe microbatch count M (default 4x stages)")
     parser.add_argument("--expert-parallel", type=int, default=-1,
                         help="expert axis size for MoE sharding (BERT)")
     parser.add_argument("--bert-layers", type=int, default=0,
@@ -676,6 +714,10 @@ def main(argv: list[str] | None = None):
         overrides["moe_experts"] = args.moe_experts
     if args.expert_parallel >= 0:
         overrides["expert_parallel"] = args.expert_parallel
+    if args.pipeline_parallel >= 0:
+        overrides["pipeline_parallel"] = args.pipeline_parallel
+    if args.pipeline_microbatches:
+        overrides["pipeline_microbatches"] = args.pipeline_microbatches
     if args.bert_layers:
         overrides["bert_layers"] = args.bert_layers
     if args.bert_hidden:

@@ -72,6 +72,19 @@ class BertConfig:
     moe_aux_weight: float = 0.01
     expert_axis: str | None = None
     expert_parallel: int = 1
+    # Pipeline parallelism (GPipe schedule, parallel/pipeline.py): with
+    # ``pipeline_axis`` set the encoder's params are a stacked
+    # ``[num_layers, ...]`` tree (created by nn.scan; shard dim 0 over the
+    # pipeline axis via ``bert_param_specs``) and the encoder runs
+    # ``pipeline_apply`` over ``pipeline_microbatches`` microbatches inside
+    # shard_map. Embeddings/pooler/heads stay replicated across stages.
+    # Outside shard_map (init, CPU tests) the same stacked params run as a
+    # sequential scan — mathematically identical, so one checkpoint serves
+    # both. num_layers must divide by pipeline_parallel; the global batch by
+    # pipeline_microbatches.
+    pipeline_axis: str | None = None
+    pipeline_parallel: int = 1
+    pipeline_microbatches: int = 0  # 0 -> 4 * pipeline_parallel
 
 
 def bert_base(**overrides) -> BertConfig:
@@ -278,6 +291,27 @@ class BertLayer(nn.Module):
         return nn.LayerNorm(epsilon=1e-12, dtype=cfg.dtype, name="ln")(x + y)
 
 
+class _ScanBertLayer(nn.Module):
+    """nn.scan target: carry = hidden states; mask/train ride as broadcast
+    positional args (train is a plain python bool — static through scan)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, train):
+        x = BertLayer(self.cfg, name="layer")(x, mask, train=train)
+        return x, None
+
+
+def _axis_bound(name: str) -> bool:
+    """True iff ``name`` is a mesh axis bound by an enclosing shard_map."""
+    try:
+        lax.axis_size(name)
+        return True
+    except NameError:
+        return False
+
+
 class BertModel(nn.Module):
     """Encoder + pooler. Returns (hidden [B,L,H], pooled [B,H])."""
 
@@ -286,18 +320,97 @@ class BertModel(nn.Module):
     def setup(self):
         cfg = self.cfg
         self.embeddings = BertEmbeddings(cfg)
-        self.layers = [BertLayer(cfg, name=f"layer_{i}") for i in range(cfg.num_layers)]
+        if cfg.pipeline_axis is not None or cfg.pipeline_parallel > 1:
+            if (
+                cfg.seq_axis is not None
+                or cfg.model_parallel > 1
+                or cfg.moe_experts
+            ):
+                raise NotImplementedError(
+                    "pipeline parallelism composes with plain DP only for "
+                    "now; unset seq_axis/model_parallel/moe_experts"
+                )
+            if cfg.num_layers % cfg.pipeline_parallel:
+                raise ValueError(
+                    f"num_layers {cfg.num_layers} not divisible by "
+                    f"pipeline_parallel {cfg.pipeline_parallel}"
+                )
+            self.encoder = nn.scan(
+                _ScanBertLayer,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                in_axes=(nn.broadcast, nn.broadcast),
+            )(cfg, name="encoder")
+            self.layers = None
+        else:
+            self.layers = [
+                BertLayer(cfg, name=f"layer_{i}") for i in range(cfg.num_layers)
+            ]
         self.pooler = nn.Dense(
             cfg.hidden_size,
             dtype=cfg.dtype,
             kernel_init=nn.initializers.normal(0.02),
         )
 
+    def _encode_pipelined(self, x, attention_mask, *, train: bool):
+        """GPipe the stacked encoder over the bound pipeline axis.
+
+        Called inside shard_map where this stage's param slice has leading
+        dim ``num_layers / S``. The per-(layer, microbatch) context slices
+        the attention mask and folds the dropout rng; drained-phase ticks
+        compute garbage that is never collected (parallel/pipeline.py).
+        """
+        from distributed_tensorflow_tpu.parallel.pipeline import pipeline_apply
+
+        cfg = self.cfg
+        S = lax.axis_size(cfg.pipeline_axis)
+        M = cfg.pipeline_microbatches or 4 * S
+        B, L = attention_mask.shape
+        need_rng = train and cfg.dropout_rate > 0.0
+        base_rng = self.make_rng("dropout") if need_rng else None
+        mask_mb = attention_mask.reshape(M, B // M, L)
+        stacked = self.variables["params"]["encoder"]["layer"]
+        # parent=None: a detached functional instance — its .apply below runs
+        # on explicit param slices, never registering as a submodule here.
+        layer = BertLayer(cfg, parent=None)
+
+        def layer_fn(p_one, h, ctx):
+            m = lax.dynamic_index_in_dim(
+                mask_mb, ctx["microbatch"], 0, keepdims=False
+            )
+            rngs = None
+            if need_rng:
+                r = jax.random.fold_in(base_rng, ctx["layer"])
+                rngs = {"dropout": jax.random.fold_in(r, ctx["microbatch"])}
+            return layer.apply({"params": p_one}, h, m, train=train, rngs=rngs)
+
+        return pipeline_apply(
+            layer_fn,
+            stacked,
+            x,
+            axis_name=cfg.pipeline_axis,
+            n_microbatches=M,
+            with_context=True,
+        )
+
     def __call__(self, input_ids, attention_mask, token_type_ids, *, train=False):
         cfg = self.cfg
         x = self.embeddings(input_ids, token_type_ids, train=train)
-        for layer in self.layers:
-            x = layer(x, attention_mask, train=train)
+        if self.layers is None:
+            if (
+                cfg.pipeline_axis is not None
+                and not self.is_initializing()
+                and _axis_bound(cfg.pipeline_axis)
+            ):
+                x = self._encode_pipelined(x, attention_mask, train=train)
+            else:
+                # Stacked params, sequential semantics (init / tests /
+                # single-stage runs) — same math as the pipelined schedule.
+                x, _ = self.encoder(x, attention_mask, train)
+        else:
+            for layer in self.layers:
+                x = layer(x, attention_mask, train=train)
         first = x[:, 0]
         if cfg.seq_axis is not None:
             # The global [CLS] token lives on seq-shard 0: psum-select it so
@@ -408,6 +521,7 @@ def bert_param_specs(
     params,
     model_axis: str | None = "model",
     expert_axis: str | None = None,
+    pipeline_axis: str | None = None,
 ):
     """PartitionSpec tree for Megatron-TP / expert sharding of BERT params.
 
@@ -447,16 +561,20 @@ def bert_param_specs(
             (("experts_b2",), P(expert_axis, None)),
         )
 
-    def spec_for(path) -> P:
+    def spec_for(path, leaf) -> P:
         names = tuple(
             p.key for p in path if isinstance(p, jax.tree_util.DictKey)
         )
+        # Stacked encoder (pipeline config): every leaf under "encoder"
+        # carries a leading [num_layers] dim sharded over the pipeline axis.
+        if pipeline_axis is not None and "encoder" in names:
+            return P(pipeline_axis, *(None,) * (leaf.ndim - 1))
         for suffix, spec in rules:
             if names[-len(suffix):] == suffix:
                 return spec
         return P()
 
-    return jax.tree_util.tree_map_with_path(lambda p, _: spec_for(p), params)
+    return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
 def make_bert_pretraining_loss(model: BertForPreTraining):

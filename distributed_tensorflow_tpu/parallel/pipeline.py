@@ -34,7 +34,12 @@ import jax.numpy as jnp
 from jax import lax
 
 # layer_fn(layer_params, activations) -> activations, applied per layer.
-LayerFn = Callable[[Any, jax.Array], jax.Array]
+# With with_context=True the signature is layer_fn(layer_params, activations,
+# ctx) where ctx = {"layer": global layer index, "microbatch": microbatch
+# index} (both int32 scalars) — what a transformer block needs to slice its
+# per-microbatch attention mask and fold a dropout rng uniquely per
+# (layer, microbatch).
+LayerFn = Callable[..., jax.Array]
 
 
 def pipeline_apply(
@@ -44,6 +49,7 @@ def pipeline_apply(
     *,
     axis_name: str = "pipeline",
     n_microbatches: int,
+    with_context: bool = False,
 ):
     """Run a stage-sharded layer stack over ``x`` with GPipe microbatching.
 
@@ -73,22 +79,30 @@ def pipeline_apply(
     T = M + S - 1
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
-    def run_stage(h):
-        def body(h, p_one):
+    n_local = jax.tree.leaves(stacked_params)[0].shape[0]
+
+    def run_stage(h, mb_idx):
+        def body(h, xs):
+            p_one, local_idx = xs
+            if with_context:
+                ctx = {"layer": stage * n_local + local_idx, "microbatch": mb_idx}
+                return layer_fn(p_one, h, ctx), None
             return layer_fn(p_one, h), None
 
-        h, _ = lax.scan(body, h, stacked_params)
+        h, _ = lax.scan(body, h, (stacked_params, jnp.arange(n_local)))
         return h
 
     def tick(buf, t):
         # Stage 0 ingests microbatch t (clamped in the drain phase — those
         # ticks compute garbage that is never collected); later stages take
-        # the neighbor's value that arrived on the previous tick.
+        # the neighbor's value that arrived on the previous tick. Stage s
+        # processes microbatch t - s on tick t (clamped the same way).
         inject = lax.dynamic_index_in_dim(
             mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
         )
         h_in = jnp.where(stage == 0, inject, buf)
-        h_out = run_stage(h_in)
+        mb_idx = jnp.clip(t - stage, 0, M - 1)
+        h_out = run_stage(h_in, mb_idx)
         buf_next = lax.ppermute(h_out, axis_name, fwd_perm)
         return buf_next, h_out
 

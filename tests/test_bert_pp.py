@@ -1,0 +1,209 @@
+"""Pipeline-parallel BERT: stage-sharded encoder over the "pipeline" axis.
+
+The invariant mirrors tests/test_bert_tp.py: a pipelined run is NOT a
+different model — the full training trajectory must match the sequential
+per-layer encoder (up to f32 reduction order), with the sequential model's
+params mapped into the stacked layout by jnp.stack.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.data.text import (
+    SyntheticMLM,
+    SyntheticMLMConfig,
+    bert_batch_specs,
+    mlm_device_batches,
+)
+from distributed_tensorflow_tpu.models.bert import (
+    BertConfig,
+    BertForPreTraining,
+    bert_param_specs,
+    make_bert_pretraining_loss,
+)
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+from distributed_tensorflow_tpu.train.step import make_state_specs, place_state
+
+L = 32
+TINY = dict(
+    vocab_size=96,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    intermediate_size=64,
+    max_position=L,
+    dropout_rate=0.0,
+)
+
+
+def _init_seq(cfg):
+    model = BertForPreTraining(cfg)
+    variables = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )
+    return jax.device_get(variables["params"])
+
+
+def _stack_params(seq_params, n_layers):
+    """Sequential layer_i tree -> the nn.scan stacked {encoder: {layer: ...}}."""
+    bert = dict(seq_params["bert"])
+    layers = [bert.pop(f"layer_{i}") for i in range(n_layers)]
+    bert["encoder"] = {"layer": jax.tree.map(lambda *xs: jnp.stack(xs), *layers)}
+    return {**seq_params, "bert": bert}
+
+
+def _unstack(stacked_layer_tree, i):
+    return jax.tree.map(lambda x: x[i], stacked_layer_tree)
+
+
+def _run(mesh, cfg_model, params, batches, n_steps, state_specs=None, batch_spec=None):
+    tx = optax.adam(1e-3)
+    state = place_state(create_train_state(params, tx), mesh, state_specs)
+    step = make_train_step(
+        make_bert_pretraining_loss(BertForPreTraining(cfg_model)),
+        tx,
+        mesh,
+        batch_spec=batch_spec,
+        state_specs=state_specs,
+    )
+    metrics = None
+    for _ in range(n_steps):
+        state, metrics = step(state, next(batches), jax.random.key(1))
+    return state, metrics
+
+
+def test_pp_training_matches_sequential(devices8):
+    init_cfg = BertConfig(**TINY)
+    seq_params = _init_seq(init_cfg)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+
+    # Reference: sequential encoder, 2-way DP.
+    mesh_dp = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    b_ref = mlm_device_batches(data, mesh_dp, 16, seed=3)
+    state_ref, m_ref = _run(mesh_dp, init_cfg, seq_params, b_ref, 3)
+
+    # Pipelined: same DP width, 2 stages, 4 microbatches over the 8-row
+    # per-shard batch.
+    pp_cfg = dataclasses.replace(
+        init_cfg, pipeline_axis="pipeline", pipeline_parallel=2,
+        pipeline_microbatches=4,
+    )
+    pp_params = _stack_params(seq_params, init_cfg.num_layers)
+    mesh_pp = build_mesh({"data": 2, "pipeline": 2}, devices=jax.devices()[:4])
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(pp_params, tx),
+        tx,
+        bert_param_specs(pp_params, model_axis=None, pipeline_axis="pipeline"),
+    )
+    b_pp = mlm_device_batches(data, mesh_pp, 16, seed=3)
+    state_pp, m_pp = _run(
+        mesh_pp,
+        pp_cfg,
+        pp_params,
+        b_pp,
+        3,
+        state_specs=specs,
+        batch_spec=bert_batch_specs(mesh_pp),
+    )
+
+    assert np.isclose(float(m_ref["loss"]), float(m_pp["loss"]), atol=1e-4), (
+        float(m_ref["loss"]),
+        float(m_pp["loss"]),
+    )
+    assert np.isclose(
+        float(m_ref["grad_norm"]), float(m_pp["grad_norm"]), rtol=1e-4
+    ), (float(m_ref["grad_norm"]), float(m_pp["grad_norm"]))
+
+    got = jax.device_get(state_pp.params)
+    ref = jax.device_get(state_ref.params)
+    stacked = got["bert"]["encoder"]["layer"]
+    for i in range(init_cfg.num_layers):
+        flat_ref = jax.tree_util.tree_leaves_with_path(ref["bert"][f"layer_{i}"])
+        flat_got = dict(
+            jax.tree_util.tree_leaves_with_path(_unstack(stacked, i))
+        )
+        for path, leaf in flat_ref:
+            np.testing.assert_allclose(
+                np.asarray(leaf),
+                np.asarray(flat_got[path]),
+                atol=5e-5,
+                err_msg=f"layer_{i} {jax.tree_util.keystr(path)}",
+            )
+    # Non-encoder leaves (embeddings, heads) are replicated across stages.
+    np.testing.assert_allclose(
+        np.asarray(ref["bert"]["embeddings"]["word"]["embedding"]),
+        np.asarray(got["bert"]["embeddings"]["word"]["embedding"]),
+        atol=5e-5,
+    )
+
+
+def test_pp_with_dropout_trains(devices8):
+    """Dropout rides the pipeline: per-(layer, microbatch) folded rngs."""
+    cfg = BertConfig(**{**TINY, "dropout_rate": 0.1}, pipeline_axis="pipeline",
+                     pipeline_parallel=2, pipeline_microbatches=2)
+    init_cfg = dataclasses.replace(cfg, pipeline_axis=None, pipeline_parallel=1)
+    seq_params = _init_seq(dataclasses.replace(init_cfg))
+    pp_params = _stack_params(seq_params, cfg.num_layers)
+    mesh = build_mesh({"data": 2, "pipeline": 2}, devices=jax.devices()[:4])
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(pp_params, tx),
+        tx,
+        bert_param_specs(pp_params, model_axis=None, pipeline_axis="pipeline"),
+    )
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+    batches = mlm_device_batches(data, mesh, 8, seed=0)
+    state, metrics = _run(
+        mesh, cfg, pp_params, batches, 2,
+        state_specs=specs, batch_spec=bert_batch_specs(mesh),
+    )
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 2
+
+
+def test_pp_param_specs_shard_only_encoder():
+    cfg = BertConfig(**TINY, pipeline_axis="pipeline", pipeline_parallel=2)
+    seq_params = _init_seq(BertConfig(**TINY))
+    pp_params = _stack_params(seq_params, cfg.num_layers)
+    specs = bert_param_specs(pp_params, model_axis=None, pipeline_axis="pipeline")
+    flat = {
+        jax.tree_util.keystr(p): s
+        for p, s in jax.tree_util.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+        )
+    }
+    for k, s in flat.items():
+        if "encoder" in k:
+            assert s[0] == "pipeline", (k, s)
+        else:
+            assert not any(a == "pipeline" for a in s if a), (k, s)
+
+
+def test_pp_rejects_tp_and_sp_composition():
+    for extra in (
+        dict(model_axis="model", model_parallel=2),
+        dict(seq_axis="seq"),
+        dict(moe_experts=2),
+    ):
+        cfg = BertConfig(
+            **TINY, pipeline_axis="pipeline", pipeline_parallel=2, **extra
+        )
+        with pytest.raises((NotImplementedError, Exception)):
+            BertForPreTraining(cfg).init(
+                jax.random.key(0),
+                jnp.zeros((1, L), jnp.int32),
+                jnp.ones((1, L), bool),
+                jnp.zeros((1, L), jnp.int32),
+                train=False,
+            )
