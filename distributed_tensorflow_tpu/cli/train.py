@@ -62,6 +62,7 @@ class WorkloadConfig:
     tensor_parallel: int = 0  # >0: model axis size for Megatron-TP (BERT)
     moe_experts: int = 0  # >0: switch-MoE FFN with this many experts (BERT)
     expert_parallel: int = 0  # >0: expert axis size for MoE sharding (BERT)
+    moe_dispatch: str = "replicated"  # "replicated" | "alltoall" (GShard a2a)
     pipeline_parallel: int = 0  # >0: pipeline axis size, stage-sharded encoder (BERT)
     pipeline_microbatches: int = 0  # GPipe M; 0 -> 4 * pipeline_parallel
     bert_layers: int = 0  # >0: override encoder depth (smoke runs)
@@ -270,7 +271,9 @@ def _build_bert_workload(cfg_kwargs: dict):
                     )
                 # Init with the GLOBAL expert count (expert_parallel=1).
                 init_cfg = dataclasses.replace(
-                    init_cfg, moe_experts=cfg.moe_experts
+                    init_cfg,
+                    moe_experts=cfg.moe_experts,
+                    moe_dispatch=cfg.moe_dispatch,
                 )
             model_cfg = init_cfg
             if seq_parallel:
@@ -660,6 +663,10 @@ def main(argv: list[str] | None = None):
                         help="model axis size for Megatron-TP sharding (BERT)")
     parser.add_argument("--moe-experts", type=int, default=-1,
                         help="switch-MoE FFN with N experts (BERT; 0 = dense FFN)")
+    parser.add_argument("--moe-dispatch", default="",
+                        choices=["", "replicated", "alltoall"],
+                        help="MoE dispatch layout (alltoall = token-sharded "
+                        "GShard capacity-buffer exchange)")
     parser.add_argument("--pipeline-parallel", type=int, default=-1,
                         help="pipeline-stage axis size for the BERT encoder "
                         "(GPipe schedule; 0 disables)")
@@ -712,6 +719,8 @@ def main(argv: list[str] | None = None):
         overrides["tensor_parallel"] = args.tensor_parallel
     if args.moe_experts >= 0:
         overrides["moe_experts"] = args.moe_experts
+    if args.moe_dispatch:
+        overrides["moe_dispatch"] = args.moe_dispatch
     if args.expert_parallel >= 0:
         overrides["expert_parallel"] = args.expert_parallel
     if args.pipeline_parallel >= 0:

@@ -72,6 +72,12 @@ class BertConfig:
     moe_aux_weight: float = 0.01
     expert_axis: str | None = None
     expert_parallel: int = 1
+    # "replicated": every expert shard routes all tokens, partial outputs
+    # psum (exact global capacity order). "alltoall": token-sharded
+    # capacity-buffer dispatch over the expert axis (GShard layout,
+    # parallel/moe.py moe_apply_a2a) — the scalable choice, and the one
+    # that composes with sequence parallelism.
+    moe_dispatch: str = "replicated"
     # Pipeline parallelism (GPipe schedule, parallel/pipeline.py): with
     # ``pipeline_axis`` set the encoder's params are a stacked
     # ``[num_layers, ...]`` tree (created by nn.scan; shard dim 0 over the
@@ -194,24 +200,21 @@ class MoeFfn(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False):
-        from distributed_tensorflow_tpu.parallel.moe import moe_apply
+        from distributed_tensorflow_tpu.parallel.moe import moe_apply, moe_apply_a2a
 
         cfg = self.cfg
-        # Unsupported compositions are rejected, not silently mis-trained:
-        # under seq parallelism the aux loss would be a per-shard scalar
-        # (violating the engine's global-loss seq contract and down-scaling
-        # the load-balance gradient by the ring size); under TP the FFN
-        # would run redundantly on every model shard. Both are r3 work.
-        if cfg.seq_axis is not None:
-            raise NotImplementedError(
-                "MoE FFN + sequence parallelism is not supported yet "
-                "(per-shard aux loss would break the seq-grad contract)"
-            )
+        # Unsupported composition is rejected, not silently mis-trained:
+        # under TP the FFN would compute redundantly on every model shard.
+        # (MoE x SP IS supported: the routing statistics psum over the seq
+        # ring + expert axis, so the aux loss satisfies the engine's
+        # global-loss seq contract — tests/test_bert_moe.py.)
         if cfg.model_parallel > 1:
             raise NotImplementedError(
                 "MoE FFN + tensor parallelism is not supported yet "
                 "(the FFN would compute redundantly on every model shard)"
             )
+        if cfg.moe_dispatch not in ("replicated", "alltoall"):
+            raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
         b, l, h = x.shape
         ff = cfg.intermediate_size
         e_local = cfg.moe_experts // cfg.expert_parallel
@@ -241,17 +244,40 @@ class MoeFfn(nn.Module):
 
         tokens = x.reshape(b * l, h)
         logits = router(tokens)
-        y, aux = moe_apply(
-            expert_fn,
-            {"w1": w1, "b1": b1, "w2": w2, "b2": b2},
-            logits,
-            tokens,
-            axis_name=cfg.expert_axis if cfg.expert_parallel > 1 else None,
+        # Token-sharding axes: the aux-loss statistics must psum over every
+        # axis the tokens are split across so the loss is the global ratio
+        # on all shards (seq contract, train/step.py). The a2a dispatch
+        # additionally shards tokens over the expert axis itself.
+        stats_axes = () if cfg.seq_axis is None else (cfg.seq_axis,)
+        ep_active = cfg.expert_parallel > 1
+        use_a2a = cfg.moe_dispatch == "alltoall" and ep_active
+        apply_kwargs = dict(
             capacity_factor=cfg.moe_capacity_factor,
             # PAD positions must not consume routing capacity or bias the
             # load-balance aux — only attention-mask-valid tokens route.
             valid=None if mask is None else mask.reshape(b * l),
         )
+        experts = {"w1": w1, "b1": b1, "w2": w2, "b2": b2}
+        if use_a2a:
+            y, aux = moe_apply_a2a(
+                expert_fn,
+                experts,
+                logits,
+                tokens,
+                axis_name=cfg.expert_axis,
+                stats_axes=stats_axes + (cfg.expert_axis,),
+                **apply_kwargs,
+            )
+        else:
+            y, aux = moe_apply(
+                expert_fn,
+                experts,
+                logits,
+                tokens,
+                axis_name=cfg.expert_axis if ep_active else None,
+                stats_axes=stats_axes,
+                **apply_kwargs,
+            )
         self.sow("intermediates", "moe_aux", aux)
         return y.reshape(b, l, h)
 

