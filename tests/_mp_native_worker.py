@@ -1,0 +1,68 @@
+"""Worker body for the cross-process native-input-pipeline test.
+
+Two of these processes form one jax.distributed job (4 CPU devices each);
+each feeds training-shaped batches through ``native_device_batches`` — the
+C++ pipeline with the multi-host stream_offset/stream_stride disjointness
+contract (data/loader.py) — and prints a digest of the rows it contributed
+to each assembled global batch (reconstructed from the global jax.Array's
+addressable shards, so the device-placement path is covered too).
+
+    python tests/_mp_native_worker.py <process_id> <num_processes> <port>
+"""
+
+import hashlib
+import json
+import sys
+
+
+def main() -> int:
+    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu.data import (
+        native_device_batches,
+        synthetic_image_classification,
+    )
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        build_mesh,
+        initialize_runtime,
+    )
+
+    initialize_runtime(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=proc_id,
+    )
+    mesh = build_mesh({"data": -1})
+
+    ds = synthetic_image_classification(256, (16, 16, 3), 10, seed=7)
+    batches = native_device_batches(ds, mesh, global_batch=32, seed=11)
+    digests = []
+    for _ in range(3):
+        batch = next(batches)
+        # Reassemble this process's rows from its addressable shards, in
+        # global row order.
+        shards = sorted(
+            batch["image"].addressable_shards, key=lambda s: s.index[0].start
+        )
+        images = np.concatenate([np.asarray(s.data) for s in shards])
+        lshards = sorted(
+            batch["label"].addressable_shards, key=lambda s: s.index[0].start
+        )
+        labels = np.concatenate([np.asarray(s.data) for s in lshards])
+        h = hashlib.sha1()
+        h.update(np.ascontiguousarray(images).tobytes())
+        h.update(np.ascontiguousarray(labels).tobytes())
+        digests.append(h.hexdigest())
+    print(json.dumps({"proc": proc_id, "digests": digests}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
