@@ -57,9 +57,9 @@ class BertConfig:
     # local-head/local-FFN projections and psums the row-parallel outputs.
     model_axis: str | None = None
     model_parallel: int = 1
-    # Single-shard attention implementation: "dense" (XLA-composed) or
-    # "flash" (Pallas kernel, ops/flash_attention.py — wins for long L).
-    # Ignored when seq_axis is set (the ring has its own blockwise kernel).
+    # Attention implementation: "dense" (XLA-composed) or "flash" (Pallas
+    # kernel, ops/flash_attention.py). With seq_axis set it also selects the
+    # ring's inner step ("flash" = Pallas kernel per streamed K/V block).
     attn_impl: str = "dense"
     # Mixture-of-experts FFN: > 0 replaces every layer's dense FFN with a
     # switch-routed MoE of ``moe_experts`` experts (parallel/moe.py). With
@@ -167,7 +167,10 @@ class BertSelfAttention(nn.Module):
         )
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
         if cfg.seq_axis is not None:
-            ctx = ring_attention(q, k, v, cfg.seq_axis, mask=mask)
+            # attn_impl picks the ring's inner step too: "flash" runs the
+            # Pallas kernel per streamed K/V block (logsumexp block merge).
+            inner = "flash" if cfg.attn_impl == "flash" else "einsum"
+            ctx = ring_attention(q, k, v, cfg.seq_axis, mask=mask, inner=inner)
         elif cfg.attn_impl == "flash":
             from distributed_tensorflow_tpu.ops import flash_attention
 

@@ -7,9 +7,12 @@ the K/V stream resident in VMEM and every matmul on the MXU.
 
 Semantics match ``parallel.ring_attention.dense_attention`` exactly (same
 layout ``[B, L, H, D]``, same key-padding-mask contract, f32 accumulation) —
-the equivalence test in tests/test_flash_attention.py pins it. Composable
-with the ring: ring attention's per-block compute can use this kernel as its
-inner step (ring = outer loop over ICI, flash = inner loop over VMEM).
+the equivalence test in tests/test_flash_attention.py pins it. Ring
+composition is implemented, not just possible: ``flash_attention_block``
+returns (o, lse) per K/V block and ``ring_attention(..., inner="flash")``
+merges the streamed blocks by logsumexp (ring = outer loop over ICI,
+flash = inner loop over VMEM; tests/test_ring_attention.py pins the
+composition against dense attention, gradients included).
 
 Kernel structure (one (batch, head, q-block) program per grid point):
   fwd:  stream K/V blocks from VMEM, online softmax, save per-row logsumexp
@@ -42,17 +45,23 @@ def _use_interpret() -> bool:
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k, scale):
     # q_ref: [BQ, D]; k_ref/v_ref: [L, D]; mask_ref: [1, L]; o: [BQ, D];
     # lse: [1, BQ]. One program per (b*h, q-block).
+    #
+    # MXU discipline: operands stay in their storage dtype (bf16) with f32
+    # accumulation via preferred_element_type — casting inputs to f32 first
+    # would force 8x-slower f32 systolic passes (the r2 kernel's mistake;
+    # dense attention never paid it). P is cast back to the value dtype for
+    # the PV matmul, exactly like the dense path's p.astype(v.dtype).
     bq, d = q_ref.shape
     l = k_ref.shape[0]
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[:]
 
     def body(j, carry):
         o, m, denom = carry
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
+        )  # [BQ, BK] f32
         mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
         s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -61,7 +70,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k, scale
         corr = jnp.exp(m - m_new)
         denom = denom * corr + jnp.sum(p, axis=-1)
         o = o * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype),
+            v_blk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return o, m_new, denom
 
@@ -112,14 +124,14 @@ def _bwd_dq_kernel(
 ):
     bq, d = q_ref.shape
     l = k_ref.shape[0]
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
+    q = q_ref[:]
+    do = do_ref[:]
     lse = lse_ref[0, :]
     delta = delta_ref[0, :]
 
     def body(j, dq):
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -131,7 +143,10 @@ def _bwd_dq_kernel(
         )
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k_blk.dtype),
+            k_blk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     dq = jnp.zeros((bq, d), jnp.float32)
@@ -145,15 +160,15 @@ def _bwd_dkv_kernel(
 ):
     bk, d = k_ref.shape
     l = q_ref.shape[0]
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    k = k_ref[:]
+    v = v_ref[:]
     j = pl.program_id(1)
     mask_blk = mask_ref[0, pl.ds(j * bk, bk)]
 
     def body(i, carry):
         dk, dv = carry
-        q_blk = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[pl.ds(i * block_q, block_q), :]
         lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
         delta_blk = delta_ref[0, pl.ds(i * block_q, block_q)]
         s = scale * jax.lax.dot_general(
@@ -161,15 +176,19 @@ def _bwd_dkv_kernel(
         )  # [BQ, BK]
         s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
         p = jnp.exp(s - lse_blk[:, None]) * mask_blk[None, :]
+        p_lo = p.astype(do_blk.dtype)
         dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_lo, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_blk[:, None])
         dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q_blk.dtype),
+            q_blk,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return dk, dv
 
@@ -180,12 +199,20 @@ def _bwd_dkv_kernel(
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(block_q, block_k, interpret, residuals, g):
+def _bwd_impl(block_q, block_k, interpret, residuals, do, dlse=None):
+    """Shared backward: flash-attention kernels over saved (q, k, v, lse).
+
+    ``dlse`` (the logsumexp cotangent, used by the ring-composable block op
+    whose lse output feeds the cross-block merge) folds into the delta term:
+    dL/ds_ij = p_ij (dp_ij - delta_i) + p_ij dlse_i, so passing
+    delta' = delta - dlse to the unchanged kernels is the exact extension.
+    """
     q, k, v, mask, o, lse = residuals
-    do = g
     bh, l, d = q.shape
     scale = d**-0.5
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [bh,l]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = delta.reshape(bh, 1, l)
     lse3 = lse.reshape(bh, 1, l)
 
@@ -231,6 +258,10 @@ def _bwd(block_q, block_k, interpret, residuals, g):
     return dq, dk, dv, None
 
 
+def _bwd(block_q, block_k, interpret, residuals, g):
+    return _bwd_impl(block_q, block_k, interpret, residuals, g)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _flash(q, k, v, mask, block_q, block_k, interpret):
     o, _ = _fwd(q, k, v, mask, block_q, block_k, interpret)
@@ -243,6 +274,65 @@ def _flash_fwd(q, k, v, mask, block_q, block_k, interpret):
 
 
 _flash.defvjp(_flash_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_block(q, k, v, mask, block_q, block_k, interpret):
+    return _fwd(q, k, v, mask, block_q, block_k, interpret)
+
+
+def _flash_block_fwd(q, k, v, mask, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, mask, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, mask, o, lse)
+
+
+def _flash_block_bwd(block_q, block_k, interpret, residuals, g):
+    do, dlse = g
+    return _bwd_impl(block_q, block_k, interpret, residuals, do, dlse)
+
+
+_flash_block.defvjp(_flash_block_fwd, _flash_block_bwd)
+
+
+def flash_attention_block(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    block_q: int = _DEFAULT_BLOCK,
+    block_k: int = _DEFAULT_BLOCK,
+    interpret: bool | None = None,
+):
+    """One flash block with its logsumexp: the ring's inner step.
+
+    Layout ``[B, L, H, D]`` like :func:`flash_attention`, but L must already
+    be a multiple of both blocks (ring shards are) and the return is
+    ``(o [B, L, H, D], lse [B, H, L])`` — block-normalized output plus the
+    per-row logsumexp, which parallel/ring_attention.py uses to merge blocks
+    exactly (numerically stable weighted combine). Differentiable in both
+    outputs (the lse cotangent rides the same backward kernels).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    b, l, h, d = q.shape
+    block_q = min(block_q, max(l, 8))
+    block_k = min(block_k, max(l, 8))
+    if l % block_q or l % block_k:
+        raise ValueError(f"ring block length {l} must divide blocks "
+                         f"({block_q}, {block_k})")
+    if mask is None:
+        mask = jnp.ones((b, l), bool)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+    mask_bh = jnp.repeat(mask.astype(jnp.float32), h, axis=0).reshape(b * h, 1, l)
+    o, lse = _flash_block(
+        to_bh(q), to_bh(k), to_bh(v), mask_bh, block_q, block_k, interpret
+    )
+    o = o.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+    return o, lse.reshape(b, h, l)
 
 
 def flash_attention(

@@ -50,7 +50,7 @@ def dense_attention(q, k, v, mask=None):
     ).astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name: str, mask=None):
+def ring_attention(q, k, v, axis_name: str, mask=None, *, inner: str = "einsum"):
     """Exact attention with Q sharded and K/V streamed around ``axis_name``.
 
     Args:
@@ -59,12 +59,22 @@ def ring_attention(q, k, v, axis_name: str, mask=None):
       axis_name: bound mesh axis to ring over (e.g. ``"seq"``).
       mask: local key-padding mask ``[B, L_local]``, True = attend; rotates
         around the ring alongside K/V.
+      inner: per-block compute. ``"einsum"`` materializes the local
+        [L_local, L_local] score block (XLA-composed); ``"flash"`` runs the
+        Pallas flash kernel per block (ops/flash_attention.py
+        ``flash_attention_block``) and merges blocks by logsumexp — the
+        O(L_local)-memory inner step for rings whose local score block
+        would not fit.
 
     Returns:
       ``[B, L_local, H, D]`` — this device's query shard attended over the
       *global* sequence, bit-comparable to :func:`dense_attention` on the
       gathered arrays (up to f32 reduction order).
     """
+    if inner == "flash":
+        return _ring_attention_flash(q, k, v, axis_name, mask)
+    if inner != "einsum":
+        raise ValueError(f"unknown ring inner {inner!r}")
     n = lax.axis_size(axis_name)
     scale = q.shape[-1] ** -0.5
     b, l_q, h, d = q.shape
@@ -113,3 +123,48 @@ def ring_attention(q, k, v, axis_name: str, mask=None):
     # A row with zero attendable keys ends with denom 0 — define output 0.
     safe = jnp.maximum(denom, 1e-37)
     return (o / safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name: str, mask=None):
+    """Ring outer loop over ICI, flash kernel inner loop over VMEM.
+
+    Each ring step computes this query shard against the streamed K/V block
+    with :func:`ops.flash_attention.flash_attention_block` (block-normalized
+    output + per-row logsumexp), then merges blocks with the numerically
+    stable weighted combine:  o = sum_j e^{lse_j - m} o_j / sum_j e^{lse_j - m}.
+    Exact — same math as the einsum inner, different blocking.
+    """
+    from distributed_tensorflow_tpu.ops.flash_attention import (
+        flash_attention_block,
+    )
+
+    n = lax.axis_size(axis_name)
+    b, l_q, h, d = q.shape
+    acc = jnp.zeros((b, l_q, h, d), jnp.float32)
+    m = jnp.full((b, h, l_q), _MASK_VALUE, jnp.float32)
+    z = jnp.zeros((b, h, l_q), jnp.float32)
+
+    def one_block(carry, _):
+        k_blk, v_blk, mask_blk, acc, m, z = carry
+        o_j, lse_j = flash_attention_block(q, k_blk, v_blk, mask_blk)
+        m_new = jnp.maximum(m, lse_j)
+        w_old = jnp.exp(m - m_new)
+        w_j = jnp.exp(lse_j - m_new)
+        acc = (
+            acc * w_old.transpose(0, 2, 1)[..., None]
+            + o_j.astype(jnp.float32) * w_j.transpose(0, 2, 1)[..., None]
+        )
+        z = z * w_old + w_j
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        if mask_blk is not None:
+            mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return (k_blk, v_blk, mask_blk, acc, m_new, z), None
+
+    carry = (k, v, mask, acc, m, z)
+    carry, _ = lax.scan(one_block, carry, None, length=n)
+    _, _, _, acc, m, z = carry
+    # Fully-masked rows: every o_j is 0, so acc is 0 regardless of z.
+    safe = jnp.maximum(z, 1e-37)
+    return (acc / safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
