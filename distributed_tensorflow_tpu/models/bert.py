@@ -57,10 +57,13 @@ class BertConfig:
     # local-head/local-FFN projections and psums the row-parallel outputs.
     model_axis: str | None = None
     model_parallel: int = 1
-    # Attention implementation: "dense" (XLA-composed) or "flash" (Pallas
-    # kernel, ops/flash_attention.py). With seq_axis set it also selects the
-    # ring's inner step ("flash" = Pallas kernel per streamed K/V block).
-    attn_impl: str = "dense"
+    # Attention implementation: "auto" (flash for L >= 256, dense below —
+    # the r3 measured crossover: flash beats dense 1.8-2.3x at L in
+    # {512, 2048} but loses at L=128 where one fused dense matmul wins),
+    # "dense" (XLA-composed), or "flash" (Pallas kernel,
+    # ops/flash_attention.py). With seq_axis set the choice also selects
+    # the ring's inner step ("flash" = Pallas kernel per streamed block).
+    attn_impl: str = "auto"
     # Mixture-of-experts FFN: > 0 replaces every layer's dense FFN with a
     # switch-routed MoE of ``moe_experts`` experts (parallel/moe.py). With
     # ``expert_axis``/``expert_parallel`` set, experts shard over that mesh
@@ -166,12 +169,17 @@ class BertSelfAttention(nn.Module):
             name=name,
         )
         q, k, v = dense("query")(x), dense("key")(x), dense("value")(x)
+        impl = cfg.attn_impl
+        if impl == "auto":
+            # Measured crossover (docs/PERF.md r3): the Pallas kernel wins
+            # from L ~ 256 up; below, one fused dense matmul is faster.
+            impl = "flash" if l >= 256 else "dense"
         if cfg.seq_axis is not None:
-            # attn_impl picks the ring's inner step too: "flash" runs the
+            # The choice picks the ring's inner step too: "flash" runs the
             # Pallas kernel per streamed K/V block (logsumexp block merge).
-            inner = "flash" if cfg.attn_impl == "flash" else "einsum"
+            inner = "flash" if impl == "flash" else "einsum"
             ctx = ring_attention(q, k, v, cfg.seq_axis, mask=mask, inner=inner)
-        elif cfg.attn_impl == "flash":
+        elif impl == "flash":
             from distributed_tensorflow_tpu.ops import flash_attention
 
             ctx = flash_attention(q, k, v, mask=mask)

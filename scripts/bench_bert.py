@@ -44,7 +44,9 @@ def train_flops_per_token(cfg, L: int) -> float:
     return 3.0 * fwd
 
 
-def bench_config(L: int, per_chip_batch: int, n_long: int = 40) -> dict:
+def bench_config(
+    L: int, per_chip_batch: int, n_long: int = 40, attn_impl: str = "dense"
+) -> dict:
     from distributed_tensorflow_tpu.models.bert import (
         BertForPreTraining,
         bert_base,
@@ -62,7 +64,7 @@ def bench_config(L: int, per_chip_batch: int, n_long: int = 40) -> dict:
         per_chip_batch, n_long = 4, 3
     gb = per_chip_batch * n
 
-    cfg = bert_base(dtype=jnp.bfloat16, max_position=max(512, L))
+    cfg = bert_base(dtype=jnp.bfloat16, max_position=max(512, L), attn_impl=attn_impl)
     model = BertForPreTraining(cfg)
     rng0 = np.random.default_rng(0)
     ids = rng0.integers(0, cfg.vocab_size, size=(gb, L)).astype(np.int32)
@@ -112,6 +114,7 @@ def bench_config(L: int, per_chip_batch: int, n_long: int = 40) -> dict:
     mfu = tokens_per_sec_chip * train_flops_per_token(cfg, L) / PEAK
     return {
         "L": L,
+        "attn": attn_impl,
         "per_chip_batch": per_chip_batch,
         "ms_per_step": round(per_step * 1e3, 2),
         "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 0),
