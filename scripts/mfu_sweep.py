@@ -68,6 +68,7 @@ def main():
     ap.add_argument("--profile", action="store_true")
     ap.add_argument("--batches", type=int, nargs="+", default=[64, 128, 256])
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--model", default="resnet50", choices=["resnet50", "inception"])
     args = ap.parse_args()
 
     from distributed_tensorflow_tpu.models import ResNet50
@@ -82,12 +83,20 @@ def main():
 
     mesh = build_mesh({"data": -1})
     n = len(jax.devices())
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    if args.model == "inception":
+        from distributed_tensorflow_tpu.models import InceptionV3
+
+        # Inception-v3 at 299x299: ~5.73 GFLOP/image fwd (standard count).
+        model = InceptionV3(num_classes=1000, dtype=jnp.bfloat16, aux_logits=False)
+        hw, flops_per_image = 299, 3 * 5.73e9
+    else:
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        hw, flops_per_image = 224, FLOPS_PER_IMAGE
     if args.remat:
         import dataclasses
         model = dataclasses.replace(model, remat=True)
     params, model_state = init_model(
-        model, jax.random.key(0), jnp.zeros((1, 224, 224, 3), jnp.float32)
+        model, jax.random.key(0), jnp.zeros((1, hw, hw, 3), jnp.float32)
     )
     # Host copies: device state gets donated inside the sweep loop.
     params = jax.device_get(params)
@@ -101,7 +110,7 @@ def main():
         rng0 = np.random.default_rng(0)
         batch = coll.shard_batch(
             {
-                "image": rng0.normal(size=(gb, 224, 224, 3)).astype(np.float32),
+                "image": rng0.normal(size=(gb, hw, hw, 3)).astype(np.float32),
                 "label": np.zeros((gb,), np.int32),
             },
             mesh,
@@ -116,7 +125,7 @@ def main():
             print(f"b={b}: FAILED {type(e).__name__}: {str(e)[:300]}")
             continue
         ips = n_steps * gb / dt / n
-        mfu = ips * FLOPS_PER_IMAGE / 197e12
+        mfu = ips * flops_per_image / 197e12
         print(
             f"b={b}/chip: {ips:.1f} img/s/chip, {dt / n_steps * 1e3:.1f} ms/step, mfu={mfu:.3f}",
             flush=True,

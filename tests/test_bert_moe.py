@@ -6,6 +6,8 @@ train identically to the same model with all experts local.
 
 import dataclasses
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -203,6 +205,7 @@ def test_moe_a2a_training_matches_replicated(devices8):
         )
 
 
+@pytest.mark.slow
 def test_moe_with_seq_parallel_trains(devices8):
     """MoE x SP unlocked: data x seq x expert mesh, a2a dispatch, global
     aux-loss statistics over both token-sharding axes."""

@@ -148,6 +148,7 @@ def test_pp_training_matches_sequential(devices8):
     )
 
 
+@pytest.mark.slow
 def test_pp_with_dropout_trains(devices8):
     """Dropout rides the pipeline: per-(layer, microbatch) folded rngs."""
     cfg = BertConfig(**{**TINY, "dropout_rate": 0.1}, pipeline_axis="pipeline",

@@ -5,6 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from distributed_tensorflow_tpu.ckpt import Checkpointer
 from distributed_tensorflow_tpu.data import (
@@ -232,5 +233,78 @@ def test_sharded_state_roundtrips(tmp_path, devices8):
             np.asarray(jax.device_get(got)), np.asarray(jax.device_get(leaf))
         )
     # A restored sharded state steps without recompile errors.
+    restored, metrics = step(restored, next(batches), rng)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.slow
+def test_pipeline_sharded_state_roundtrips(tmp_path, devices8):
+    """Checkpoint/restore preserves the pipeline-stage-sharded stacked
+    encoder (leading [num_layers] dim over the pipeline axis) exactly."""
+    import dataclasses
+
+    import optax
+
+    from distributed_tensorflow_tpu.data.text import (
+        SyntheticMLM,
+        SyntheticMLMConfig,
+        bert_batch_specs,
+        mlm_device_batches,
+    )
+    from distributed_tensorflow_tpu.models.bert import (
+        BertConfig,
+        BertForPreTraining,
+        bert_param_specs,
+        make_bert_pretraining_loss,
+    )
+    from distributed_tensorflow_tpu.train.step import make_state_specs
+
+    L = 16
+    init_cfg = BertConfig(
+        vocab_size=64, hidden_size=16, num_layers=2, num_heads=4,
+        intermediate_size=32, max_position=L, dropout_rate=0.0,
+        pipeline_parallel=2, pipeline_microbatches=2,
+    )
+    pp_cfg = dataclasses.replace(init_cfg, pipeline_axis="pipeline")
+    variables = BertForPreTraining(init_cfg).init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )
+    params = jax.device_get(variables["params"])
+    mesh = build_mesh({"data": 2, "pipeline": 2}, devices=jax.devices()[:4])
+    tx = optax.adam(1e-3)
+    host = create_train_state(params, tx)
+    specs = make_state_specs(
+        host, tx, bert_param_specs(params, model_axis=None, pipeline_axis="pipeline")
+    )
+    state = place_state(host, mesh, specs)
+    step = make_train_step(
+        make_bert_pretraining_loss(BertForPreTraining(pp_cfg)),
+        tx, mesh, batch_spec=bert_batch_specs(mesh), state_specs=specs,
+    )
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=64, seq_len=L, seed=0))
+    batches = mlm_device_batches(data, mesh, 8, seed=1)
+    rng = jax.random.key(0)
+    for _ in range(2):
+        state, _ = step(state, next(batches), rng)
+
+    with Checkpointer(tmp_path / "pp") as ckpt:
+        ckpt.save(2, state)
+        ckpt.wait()
+        fresh = place_state(create_train_state(params, tx), mesh, specs)
+        restored, start = ckpt.restore_latest(fresh)
+
+    assert start == 2
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state.params):
+        got = dict(jax.tree_util.tree_leaves_with_path(restored.params))[path]
+        assert got.sharding.is_equivalent_to(
+            leaf.sharding, leaf.ndim
+        ), jax.tree_util.keystr(path)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(got)), np.asarray(jax.device_get(leaf))
+        )
     restored, metrics = step(restored, next(batches), rng)
     assert np.isfinite(float(metrics["loss"]))

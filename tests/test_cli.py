@@ -222,9 +222,13 @@ def test_cli_bert_pipeline_parallel(tmp_path):
             "--pipeline-parallel=2",
             "--pipeline-microbatches=2",
             "--log-every=1",
+            "--eval-every=2",
+            "--eval-batches=1",
             f"--metrics-jsonl={tmp_path}/m.jsonl",
         ]
     )
     assert rc == 0
-    rec = json.loads((tmp_path / "m.jsonl").read_text().splitlines()[-1])
-    assert "mlm_loss" in rec and rec["step"] == 2
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert any("mlm_loss" in r and r.get("step") == 2 for r in lines)
+    # Eval runs through the stage-sharded encoder too.
+    assert any("eval_mlm_accuracy" in r for r in lines)

@@ -69,6 +69,7 @@ def test_resnet50_param_tree_unchanged_by_backend():
     assert trees["conv"] == trees["pallas"]
 
 
+@pytest.mark.slow
 def test_resnet50_forward_agrees_across_backends():
     """Same params, same output, pallas (interpret) vs nn.Conv backend."""
     import dataclasses
