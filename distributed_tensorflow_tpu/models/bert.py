@@ -214,20 +214,18 @@ class MoeFfn(nn.Module):
         from distributed_tensorflow_tpu.parallel.moe import moe_apply, moe_apply_a2a
 
         cfg = self.cfg
-        # Unsupported composition is rejected, not silently mis-trained:
-        # under TP the FFN would compute redundantly on every model shard.
-        # (MoE x SP IS supported: the routing statistics psum over the seq
-        # ring + expert axis, so the aux loss satisfies the engine's
-        # global-loss seq contract — tests/test_bert_moe.py.)
-        if cfg.model_parallel > 1:
-            raise NotImplementedError(
-                "MoE FFN + tensor parallelism is not supported yet "
-                "(the FFN would compute redundantly on every model shard)"
-            )
+        # All three sharding families compose here: expert-parallel (stacked
+        # expert dim over "expert"), sequence-parallel (routing statistics
+        # psum over the seq ring — engine's global-loss contract), and
+        # tensor-parallel (each expert's FFN hidden dim Megatron-sharded
+        # over "model": column-parallel w1/b1, row-parallel w2 with the
+        # partial outputs psum'd after dispatch; b2 enters as b2/tp on each
+        # shard so the psum reconstructs it exactly once).
         if cfg.moe_dispatch not in ("replicated", "alltoall"):
             raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
         b, l, h = x.shape
-        ff = cfg.intermediate_size
+        tp = cfg.model_parallel
+        ff_local = cfg.intermediate_size // tp
         e_local = cfg.moe_experts // cfg.expert_parallel
         init = nn.initializers.normal(0.02)
         router = nn.Dense(
@@ -237,11 +235,11 @@ class MoeFfn(nn.Module):
             kernel_init=init,
             name="router",
         )
-        w1 = self.param("experts_w1", init, (e_local, h, ff), jnp.float32)
+        w1 = self.param("experts_w1", init, (e_local, h, ff_local), jnp.float32)
         b1 = self.param(
-            "experts_b1", nn.initializers.zeros_init(), (e_local, ff), jnp.float32
+            "experts_b1", nn.initializers.zeros_init(), (e_local, ff_local), jnp.float32
         )
-        w2 = self.param("experts_w2", init, (e_local, ff, h), jnp.float32)
+        w2 = self.param("experts_w2", init, (e_local, ff_local, h), jnp.float32)
         b2 = self.param(
             "experts_b2", nn.initializers.zeros_init(), (e_local, h), jnp.float32
         )
@@ -251,7 +249,9 @@ class MoeFfn(nn.Module):
                 tokens @ p["w1"].astype(cfg.dtype) + p["b1"].astype(cfg.dtype),
                 approximate=False,
             )
-            return t @ p["w2"].astype(cfg.dtype) + p["b2"].astype(cfg.dtype)
+            # 1/tp of the bias per model shard: the post-dispatch _tp_psum
+            # sums the row-parallel partials AND reassembles b2 exactly once.
+            return t @ p["w2"].astype(cfg.dtype) + p["b2"].astype(cfg.dtype) / tp
 
         tokens = x.reshape(b * l, h)
         logits = router(tokens)
@@ -289,6 +289,7 @@ class MoeFfn(nn.Module):
                 stats_axes=stats_axes,
                 **apply_kwargs,
             )
+        y = _tp_psum(cfg, y)
         self.sow("intermediates", "moe_aux", aux)
         return y.reshape(b, l, h)
 
@@ -590,11 +591,15 @@ def bert_param_specs(
             (("intermediate", "bias"), P(model_axis)),
             (("output", "kernel"), P(model_axis, None)),
         )
-    if expert_axis is not None:
+    if expert_axis is not None or model_axis is not None:
+        # MoE expert stacks: dim 0 over the expert axis; with TP the FFN
+        # hidden dim is additionally Megatron-sharded over the model axis
+        # (w1 column-parallel, w2 row-parallel, b1 column-parallel, b2
+        # replicated across model — it enters as b2/tp per shard).
         rules += (
-            (("experts_w1",), P(expert_axis, None, None)),
-            (("experts_w2",), P(expert_axis, None, None)),
-            (("experts_b1",), P(expert_axis, None)),
+            (("experts_w1",), P(expert_axis, None, model_axis)),
+            (("experts_w2",), P(expert_axis, model_axis, None)),
+            (("experts_b1",), P(expert_axis, model_axis)),
             (("experts_b2",), P(expert_axis, None)),
         )
 

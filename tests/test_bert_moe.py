@@ -134,8 +134,6 @@ def test_moe_bert_ep_training_matches_local(devices8):
 
 
 def test_unsupported_combinations_fail_loudly(devices8, tmp_path):
-    import pytest
-
     from distributed_tensorflow_tpu.cli import main
 
     # expert axis without any expert-sharded params
@@ -143,13 +141,81 @@ def test_unsupported_combinations_fail_loudly(devices8, tmp_path):
         main(["--config=bert_base", "--steps=1", "--global-batch=8",
               "--expert-parallel=2"])
 
-    # MoE + tensor parallelism: rejected at trace time, not mis-trained.
-    from distributed_tensorflow_tpu.models.bert import MoeFfn
 
-    x = jnp.zeros((1, 4, 32))
-    cfg = BertConfig(**TINY_MOE, model_axis="model", model_parallel=2)
-    with pytest.raises(NotImplementedError, match="tensor parallelism"):
-        MoeFfn(cfg).init(jax.random.key(0), x)
+def test_moe_tp_training_matches_unsharded(devices8):
+    """MoE x TP: each expert's FFN hidden Megatron-sharded over 'model'
+    (w1/b1 column-parallel, w2 row-parallel, b2/tp per shard) — the
+    trajectory must match the unsharded MoE model exactly."""
+    init_cfg = BertConfig(**TINY_MOE)
+    params = _init_global(init_cfg)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+
+    mesh_ref = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    state_ref, m_ref = _run(
+        mesh_ref, init_cfg, params, mlm_device_batches(data, mesh_ref, 16, seed=3), 3
+    )
+
+    mesh_tp = build_mesh({"data": 2, "model": 4})
+    tp_cfg = dataclasses.replace(
+        init_cfg, model_axis="model", model_parallel=4
+    )
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis="model", expert_axis=None),
+    )
+    state_tp, m_tp = _run(
+        mesh_tp,
+        tp_cfg,
+        params,
+        mlm_device_batches(data, mesh_tp, 16, seed=3),
+        3,
+        state_specs=specs,
+    )
+
+    assert np.isclose(float(m_ref["loss"]), float(m_tp["loss"]), atol=1e-4), (
+        float(m_ref["loss"]),
+        float(m_tp["loss"]),
+    )
+    assert np.isclose(float(m_ref["moe_aux"]), float(m_tp["moe_aux"]), atol=1e-5)
+    flat_ref = jax.tree_util.tree_leaves_with_path(jax.device_get(state_ref.params))
+    flat_tp = dict(jax.tree_util.tree_leaves_with_path(jax.device_get(state_tp.params)))
+    for path, leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(leaf),
+            np.asarray(flat_tp[path]),
+            atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+@pytest.mark.slow
+def test_moe_ep_tp_composition_trains(devices8):
+    """The triple: data x expert x model with a2a dispatch trains."""
+    cfg = BertConfig(
+        **TINY_MOE,
+        model_axis="model",
+        model_parallel=2,
+        expert_axis="expert",
+        expert_parallel=2,
+        moe_dispatch="alltoall",
+    )
+    init_cfg = BertConfig(**TINY_MOE)
+    params = _init_global(init_cfg)
+    mesh = build_mesh({"data": 2, "expert": 2, "model": 2})
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis="model", expert_axis="expert"),
+    )
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+    batches = mlm_device_batches(data, mesh, 8, seed=0)
+    state, metrics = _run(mesh, cfg, params, batches, 2, state_specs=specs)
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["moe_aux"]) > 0
+    assert int(state.step) == 2
 
 
 def test_moe_a2a_training_matches_replicated(devices8):
