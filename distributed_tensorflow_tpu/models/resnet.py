@@ -64,7 +64,10 @@ class PointwiseConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from distributed_tensorflow_tpu.ops.pointwise_conv import pointwise_matmul
+        from distributed_tensorflow_tpu.ops.pointwise_conv import (
+            pointwise_conv_n64,
+            pointwise_matmul,
+        )
 
         s = self.strides if isinstance(self.strides, int) else self.strides[0]
         if s > 1:
@@ -80,7 +83,12 @@ class PointwiseConv(nn.Module):
         # the backward ops.
         b, h, w, _ = x.shape
         k2 = kernel[0, 0].astype(self.dtype)
-        if self.backend == "pallas":
+        if self.backend == "pallas" and self.features == 64 and cin >= 128:
+            # N=64 outputs live in XLA's B-minor layout; the dedicated
+            # layout-native dgrad kernel avoids the boundary relayout that
+            # sinks the generic path here (ops/pointwise_conv.py).
+            y = pointwise_conv_n64(x.astype(self.dtype), k2)
+        elif self.backend == "pallas":
             # Flatten in H,W,B,C order: XLA:TPU's layout assignment places
             # these conv activations as {3,0,2,1} (physically H,W,B,C), so
             # this transpose+reshape lowers to a bitcast at the Pallas
