@@ -169,3 +169,48 @@ def test_bert_stale_mode(devices8):
         state, metrics = step(state, next(batches), rng)
         losses.append(float(metrics["loss"]))
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+@pytest.mark.slow
+def test_bert_seq_parallel_flash_inner_equals_dense(devices8):
+    """ring x flash THROUGH the full model: seq-parallel BERT with the
+    Pallas kernel as the ring's inner step trains identically to dense."""
+    results = {}
+    for name, spec, seq_axis, seq_sharded, attn in [
+        ("dense", {"data": 2}, None, False, "dense"),
+        ("ringflash", {"data": 2, "seq": 4}, "seq", True, "flash"),
+    ]:
+        devices = jax.devices()[: 2 if name == "dense" else 8]
+        mesh = build_mesh(spec, devices=devices)
+        _, params = _init(_tiny_cfg(), key=7, l=32)
+        model = BertForPreTraining(_tiny_cfg(seq_axis=seq_axis, attn_impl=attn))
+        tx = optax.sgd(0.1)
+        state = place_state(create_train_state(params, tx), mesh)
+        step = make_train_step(
+            make_bert_pretraining_loss(model),
+            tx,
+            mesh,
+            batch_spec=bert_batch_specs(mesh, seq_sharded=seq_sharded),
+        )
+        data = SyntheticMLM(SyntheticMLMConfig(vocab_size=100, seq_len=32, seed=2))
+        batches = mlm_device_batches(
+            data, mesh, global_batch=8, seq_sharded=seq_sharded, seed=0
+        )
+        rng = jax.random.key(3)
+        ls = []
+        for _ in range(2):
+            state, metrics = step(state, next(batches), rng)
+            ls.append(float(metrics["loss"]))
+        results[name] = (
+            ls,
+            jax.tree.map(np.asarray, jax.device_get(state.params)),
+        )
+
+    np.testing.assert_allclose(
+        results["ringflash"][0], results["dense"][0], rtol=5e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=5e-5),
+        results["ringflash"][1],
+        results["dense"][1],
+    )
