@@ -59,6 +59,7 @@ class WorkloadConfig:
     mode: str = "sync"  # "sync" | "stale"
     staleness: int = 0
     seq_parallel: int = 0  # >0: seq axis size for ring attention (BERT)
+    sp_impl: str = "ring"  # "ring" | "ulysses" (all-to-all head re-partition)
     tensor_parallel: int = 0  # >0: model axis size for Megatron-TP (BERT)
     moe_experts: int = 0  # >0: switch-MoE FFN with this many experts (BERT)
     expert_parallel: int = 0  # >0: expert axis size for MoE sharding (BERT)
@@ -277,7 +278,9 @@ def _build_bert_workload(cfg_kwargs: dict):
                 )
             model_cfg = init_cfg
             if seq_parallel:
-                model_cfg = dataclasses.replace(model_cfg, seq_axis="seq")
+                model_cfg = dataclasses.replace(
+                    model_cfg, seq_axis="seq", sp_impl=cfg.sp_impl
+                )
             if tp > 1:
                 model_cfg = dataclasses.replace(
                     model_cfg, model_axis="model", model_parallel=tp
@@ -658,7 +661,10 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--global-batch", type=int, default=0)
     parser.add_argument("--image-size", type=int, default=0)
     parser.add_argument("--seq-parallel", type=int, default=-1,
-                        help="seq axis size for ring attention (BERT)")
+                        help="seq axis size for sequence parallelism (BERT)")
+    parser.add_argument("--sp-impl", default="", choices=["", "ring", "ulysses"],
+                        help="sequence-parallel strategy: ring (K/V streamed "
+                        "over ICI) or ulysses (all-to-all head re-partition)")
     parser.add_argument("--tensor-parallel", type=int, default=-1,
                         help="model axis size for Megatron-TP sharding (BERT)")
     parser.add_argument("--moe-experts", type=int, default=-1,
@@ -715,6 +721,8 @@ def main(argv: list[str] | None = None):
         overrides["image_size"] = args.image_size
     if args.seq_parallel >= 0:
         overrides["seq_parallel"] = args.seq_parallel
+    if args.sp_impl:
+        overrides["sp_impl"] = args.sp_impl
     if args.tensor_parallel >= 0:
         overrides["tensor_parallel"] = args.tensor_parallel
     if args.moe_experts >= 0:

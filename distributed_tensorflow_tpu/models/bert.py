@@ -50,6 +50,12 @@ class BertConfig:
     # attention. With an axis set, the model must run inside shard_map with
     # the sequence dim of all [B, L] inputs sharded over that axis.
     seq_axis: str | None = None
+    # Sequence-parallel strategy: "ring" streams K/V blocks around the ICI
+    # ring (parallel/ring_attention.py, no head-count constraint);
+    # "ulysses" re-partitions sharding from sequence to heads with two
+    # all_to_alls and runs full-sequence attention per local head group
+    # (parallel/ulysses.py; needs num_heads % ring size == 0). Both exact.
+    sp_impl: str = "ring"
     # Tensor (model) parallelism: Megatron-style sharding of attention heads
     # and the FFN hidden dim over ``model_axis`` with ``model_parallel``
     # shards. Params are created GLOBAL (init with model_parallel=1 config)
@@ -175,10 +181,22 @@ class BertSelfAttention(nn.Module):
             # from L ~ 256 up; below, one fused dense matmul is faster.
             impl = "flash" if l >= 256 else "dense"
         if cfg.seq_axis is not None:
-            # The choice picks the ring's inner step too: "flash" runs the
-            # Pallas kernel per streamed K/V block (logsumexp block merge).
-            inner = "flash" if impl == "flash" else "einsum"
-            ctx = ring_attention(q, k, v, cfg.seq_axis, mask=mask, inner=inner)
+            if cfg.sp_impl == "ulysses":
+                from distributed_tensorflow_tpu.parallel.ulysses import (
+                    ulysses_attention,
+                )
+
+                ctx = ulysses_attention(
+                    q, k, v, cfg.seq_axis, mask=mask,
+                    inner="flash" if impl == "flash" else "dense",
+                )
+            else:
+                # The choice picks the ring's inner step too: "flash" runs
+                # the Pallas kernel per streamed K/V block (logsumexp merge).
+                inner = "flash" if impl == "flash" else "einsum"
+                ctx = ring_attention(
+                    q, k, v, cfg.seq_axis, mask=mask, inner=inner
+                )
         elif impl == "flash":
             from distributed_tensorflow_tpu.ops import flash_attention
 

@@ -1,0 +1,89 @@
+"""All-to-all (Ulysses-style) sequence parallelism — the ring's alternative.
+
+The task brief names both long-context strategies ("ring attention or
+all-to-all sequence/context parallelism"); the framework ships both, same
+contract, different data movement:
+
+- **Ring** (parallel/ring_attention.py): Q stays put, K/V blocks stream
+  around the ICI ring; compute is blockwise online-softmax. Communication
+  is O(S) neighbor hops fully overlappable with block compute.
+- **Ulysses** (this module): two `all_to_all`s re-partition the sharding
+  from sequence to heads — each device then computes *full-sequence*
+  attention for its `H/S` local heads with any single-device kernel (dense
+  or the Pallas flash kernel), and a reverse exchange restores the
+  sequence sharding. Communication is 2 all-to-alls of the activations;
+  attention itself needs no modification at all.
+
+Ulysses requires ``num_heads % ring_size == 0`` and holds full-L K/V for
+its local heads (memory O(L * H/S) vs the ring's O(L_local * H)); the ring
+has no head-count constraint. Both are exact.
+
+Layout contract matches ring_attention: ``[B, L_local, H, D]`` shards with
+the sequence dim over the bound axis; key-padding mask ``[B, L_local]``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _seq_to_heads(x, axis_name: str, s: int):
+    """[B, L_loc, H, D] seq-sharded -> [B, L, H/S, D] head-sharded.
+
+    Tiled all_to_all: my heads split into S groups (group i -> device i);
+    the received L_loc chunks concatenate along the sequence in rank order,
+    which is exactly the contiguous-slice seq sharding of the loaders.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _heads_to_seq(x, axis_name: str, s: int):
+    """[B, L, H/S, D] head-sharded -> [B, L_loc, H, D] seq-sharded (inverse)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q, k, v, axis_name: str, mask=None, *, inner: str = "dense"
+):
+    """Exact attention over the global sequence via head re-partitioning.
+
+    Args:
+      q, k, v: local shards ``[B, L_local, H, D]``; ``H`` must divide by the
+        axis size.
+      axis_name: bound mesh axis carrying the sequence sharding.
+      mask: local key-padding mask ``[B, L_local]`` (all-gathered once —
+        bools are cheap relative to the activation exchanges).
+      inner: the single-device attention applied per local head group:
+        ``"dense"`` or ``"flash"`` (Pallas kernel — viable here because each
+        device sees the full sequence, unlike the ring's streamed blocks).
+
+    Returns:
+      ``[B, L_local, H, D]`` — bit-comparable to
+        ``ring_attention``/``dense_attention`` up to f32 reduction order.
+    """
+    s = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % s:
+        raise ValueError(f"num_heads {h} not divisible by axis size {s}; "
+                         "use ring_attention for this geometry")
+    qh = _seq_to_heads(q, axis_name, s)
+    kh = _seq_to_heads(k, axis_name, s)
+    vh = _seq_to_heads(v, axis_name, s)
+    full_mask = None
+    if mask is not None:
+        full_mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    if inner == "flash":
+        from distributed_tensorflow_tpu.ops.flash_attention import flash_attention
+
+        ctx = flash_attention(qh, kh, vh, mask=full_mask)
+    elif inner == "dense":
+        from distributed_tensorflow_tpu.parallel.ring_attention import (
+            dense_attention,
+        )
+
+        ctx = dense_attention(qh, kh, vh, mask=full_mask)
+    else:
+        raise ValueError(f"unknown ulysses inner {inner!r}")
+    return _heads_to_seq(ctx, axis_name, s)

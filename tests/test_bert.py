@@ -214,3 +214,51 @@ def test_bert_seq_parallel_flash_inner_equals_dense(devices8):
         results["ringflash"][1],
         results["dense"][1],
     )
+
+
+@pytest.mark.slow
+def test_bert_seq_parallel_ulysses_equals_dense(devices8):
+    """Ulysses SP through the full model: all-to-all head re-partitioning
+    trains identically to the dense model (mirrors the ring test)."""
+    results = {}
+    for name, spec, seq_axis, seq_sharded in [
+        ("dense", {"data": 2}, None, False),
+        # tiny cfg has 2 heads -> 2-way seq (ulysses needs H % S == 0)
+        ("ulysses", {"data": 2, "seq": 2}, "seq", True),
+    ]:
+        devices = jax.devices()[: 2 if name == "dense" else 4]
+        mesh = build_mesh(spec, devices=devices)
+        _, params = _init(_tiny_cfg(), key=7, l=32)
+        model = BertForPreTraining(
+            _tiny_cfg(seq_axis=seq_axis, sp_impl="ulysses")
+        )
+        tx = optax.sgd(0.1)
+        state = place_state(create_train_state(params, tx), mesh)
+        step = make_train_step(
+            make_bert_pretraining_loss(model),
+            tx,
+            mesh,
+            batch_spec=bert_batch_specs(mesh, seq_sharded=seq_sharded),
+        )
+        data = SyntheticMLM(SyntheticMLMConfig(vocab_size=100, seq_len=32, seed=2))
+        batches = mlm_device_batches(
+            data, mesh, global_batch=8, seq_sharded=seq_sharded, seed=0
+        )
+        rng = jax.random.key(3)
+        ls = []
+        for _ in range(2):
+            state, metrics = step(state, next(batches), rng)
+            ls.append(float(metrics["loss"]))
+        results[name] = (
+            ls,
+            jax.tree.map(np.asarray, jax.device_get(state.params)),
+        )
+
+    np.testing.assert_allclose(
+        results["ulysses"][0], results["dense"][0], rtol=1e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+        results["ulysses"][1],
+        results["dense"][1],
+    )

@@ -1,0 +1,84 @@
+"""Ulysses (all-to-all) sequence parallelism ≡ dense attention.
+
+The second long-context strategy next to the ring (parallel/ulysses.py):
+re-partition sharding from sequence to heads with two all_to_alls, run any
+single-device attention per local head group, exchange back. Exactness and
+gradients are pinned against dense attention, for both inner kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel.ring_attention import dense_attention
+from distributed_tensorflow_tpu.parallel.ulysses import ulysses_attention
+
+
+def _rand_qkv(key, b=2, l=32, h=4, d=16, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, l, h, d), dtype) for k in ks)
+
+
+def _mask():
+    m = np.ones((2, 32), bool)
+    m[0, 22:] = False
+    m[1, 5:9] = False
+    return jnp.asarray(m)
+
+
+@pytest.mark.parametrize("inner", ["dense", "flash"])
+def test_ulysses_equals_dense(data_seq_mesh, inner):
+    q, k, v = _rand_qkv(jax.random.key(0))
+    mask = _mask()
+    ref = dense_attention(q, k, v, mask)
+
+    uly = jax.shard_map(
+        lambda q, k, v, m: ulysses_attention(q, k, v, "seq", mask=m, inner=inner),
+        mesh=data_seq_mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    out = uly(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_ulysses_gradients_match_dense(data_seq_mesh):
+    q, k, v = _rand_qkv(jax.random.key(1))
+    mask = _mask()
+
+    uly = jax.shard_map(
+        lambda q, k, v, m: ulysses_attention(q, k, v, "seq", mask=m),
+        mesh=data_seq_mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+
+    def loss_uly(q, k, v):
+        return jnp.sum(jnp.sin(uly(q, k, v, mask)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(dense_attention(q, k, v, mask)))
+
+    g_uly = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_uly, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-4, err_msg=f"d{name}"
+        )
+
+
+def test_ulysses_rejects_indivisible_heads(data_seq_mesh):
+    q, k, v = _rand_qkv(jax.random.key(2), h=6)  # 6 % 4 != 0
+    uly = jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "seq"),
+        mesh=data_seq_mesh,
+        in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        uly(q, k, v)
