@@ -1,19 +1,15 @@
-"""Per-op duel: XLA conv backward vs Pallas pointwise kernels, real TPU.
+"""Standalone Pallas pointwise-kernel bench + numerics, real TPU.
 
-For each hot 1x1-conv shape from the b=128 ResNet-50 trace
-(scripts/hlo_breakdown.py), times four things with the RTT-cancelling
-on-device-loop harness from scripts/roofline.py:
+For each hot 1x1-conv shape from the b=128 ResNet-50 trace, times the
+Pallas dgrad/wgrad kernels with the RTT-cancelling on-device-loop harness
+from scripts/roofline.py and prints achieved GB/s against the chip's
+measured ~650 GB/s streaming ceiling. The XLA-side comparison numbers come
+from the in-step trace (scripts/hlo_breakdown.py) — do NOT time
+vjp-of-conv inside an on-device loop here: the conv closure's operands
+become program constants that ship through the tunnel at compile time
+(minutes per program; docs/PERF.md methodology note).
 
-  xla_dgrad   — vjp of lax.conv_general_dilated w.r.t. input
-  pl_dgrad    — ops.pointwise_conv._dgrad_pallas
-  xla_wgrad   — vjp of the conv w.r.t. kernel
-  pl_wgrad    — ops.pointwise_conv._wgrad_pallas
-
-and prints achieved GB/s (traffic = operands read + result written once)
-so both can be compared against the chip's measured ~650 GB/s streaming
-ceiling.  Numerics are checked against einsum references first.
-
-    python scripts/pw_bench.py [--shapes stage1]
+    python scripts/pw_bench.py [--shapes stage1] [--check]
 """
 
 from __future__ import annotations
@@ -90,33 +86,23 @@ def bench_shape(b, hw, k, n):
 
     eps = jnp.bfloat16(1e-8)
 
-    def xla_dgrad(g):
-        _, vjp = jax.vjp(lambda xx: conv_nhwc(xx, w4), x4)
-        (dx,) = vjp(g)
-        return (g * (1 + eps * dx[0, 0, 0, 0]),)
+    # All large arrays ride the loop carry (never closures — they would
+    # become program constants shipped through the tunnel at compile time).
+    def pl_dgrad(g, w):
+        dx = _dgrad_pallas(g, w, interpret=False)
+        return (g * (1 + eps * dx[0, 0]), w)
 
-    def pl_dgrad(g):
-        dx = _dgrad_pallas(g, w2, interpret=False)
-        return (g * (1 + eps * dx[0, 0]),)
-
-    def xla_wgrad(g):
-        _, vjp = jax.vjp(lambda ww: conv_nhwc(x4, ww), w4)
-        (dw,) = vjp(g)
-        return (g * (1 + eps * dw[0, 0, 0, 0].astype(g.dtype)),)
-
-    def pl_wgrad(g):
-        dw = _wgrad_pallas(x2, g, interpret=False)
-        return (g * (1 + eps * dw[0, 0].astype(g.dtype)),)
+    def pl_wgrad(g, x):
+        dw = _wgrad_pallas(x, g, interpret=False)
+        return (g * (1 + eps * dw[0, 0].astype(g.dtype)), x)
 
     est = bytes_dgrad / 300e9
     rows = []
-    for name, body, arg, nbytes in [
-        ("xla_dgrad", xla_dgrad, g4, bytes_dgrad),
-        ("pl_dgrad", pl_dgrad, g2, bytes_dgrad),
-        ("xla_wgrad", xla_wgrad, g4, bytes_wgrad),
-        ("pl_wgrad", pl_wgrad, g2, bytes_wgrad),
+    for name, body, args, nbytes in [
+        ("pl_dgrad", pl_dgrad, (g2, w2), bytes_dgrad),
+        ("pl_wgrad", pl_wgrad, (g2, x2), bytes_wgrad),
     ]:
-        sec, _ = per_iter(body, (arg,), est_iter_sec=est, target_sec=0.5, repeats=3)
+        sec, _ = per_iter(body, args, est_iter_sec=est, target_sec=0.5, repeats=3)
         rows.append((name, sec * 1e3, nbytes / sec / 1e9))
     print(f"shape M={m} K={k} N={n}:")
     for name, ms, gbps in rows:
