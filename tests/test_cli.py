@@ -232,3 +232,24 @@ def test_cli_bert_pipeline_parallel(tmp_path):
     assert any("mlm_loss" in r and r.get("step") == 2 for r in lines)
     # Eval runs through the stage-sharded encoder too.
     assert any("eval_mlm_accuracy" in r for r in lines)
+
+
+@pytest.mark.slow
+def test_cli_bert_seq_parallel_ulysses(tmp_path):
+    rc = main(
+        [
+            "--config=bert_base",
+            "--steps=2",
+            "--global-batch=8",
+            "--bert-layers=1",
+            "--bert-hidden=32",
+            "--bert-vocab=256",
+            "--seq-parallel=2",
+            "--sp-impl=ulysses",
+            "--log-every=2",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    rec = json.loads((tmp_path / "m.jsonl").read_text().splitlines()[-1])
+    assert "mlm_loss" in rec
