@@ -53,7 +53,9 @@ class WorkloadConfig:
     num_steps: int
     learning_rate: float
     momentum: float = 0.9
-    optimizer: str = "sgd"  # "sgd" | "adam"
+    optimizer: str = "sgd"  # "sgd" | "adam" | "adamw"
+    weight_decay: float = 0.0  # adamw decoupled weight decay
+    clip_norm: float = 0.0  # >0: global-norm gradient clipping
     lr_schedule: str = "constant"  # "constant" | "warmup_cosine" | "piecewise"
     warmup_steps: int = 0
     mode: str = "sync"  # "sync" | "stale"
@@ -108,11 +110,19 @@ def make_lr_schedule(cfg: WorkloadConfig) -> optax.Schedule:
 
 def _make_tx(cfg: WorkloadConfig) -> tuple[optax.GradientTransformation, optax.Schedule]:
     schedule = make_lr_schedule(cfg)
-    if cfg.optimizer == "adam":
-        return optax.adam(schedule), schedule
-    if cfg.momentum:
-        return optax.sgd(schedule, momentum=cfg.momentum), schedule
-    return optax.sgd(schedule), schedule
+    if cfg.optimizer == "adamw":
+        tx = optax.adamw(schedule, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "adam":
+        tx = optax.adam(schedule)
+    elif cfg.momentum:
+        tx = optax.sgd(schedule, momentum=cfg.momentum)
+    else:
+        tx = optax.sgd(schedule)
+    if cfg.clip_norm > 0:
+        # Clip BEFORE the optimizer update (the canonical BERT/large-batch
+        # recipe): global-norm clipping over the full (already psum'd) tree.
+        tx = optax.chain(optax.clip_by_global_norm(cfg.clip_norm), tx)
+    return tx, schedule
 
 
 def _image_batches(cfg, ds, mesh, model_hw, *, train, seed, start_step=0):
@@ -493,7 +503,11 @@ def _presets() -> dict[str, WorkloadConfig]:
             global_batch=256,
             num_steps=10000,
             learning_rate=1e-4,
-            optimizer="adam",
+            # The canonical BERT pretraining recipe: AdamW with decoupled
+            # weight decay + global-norm clipping at 1.0.
+            optimizer="adamw",
+            weight_decay=0.01,
+            clip_norm=1.0,
             lr_schedule="warmup_cosine",
             warmup_steps=1000,
         ),
