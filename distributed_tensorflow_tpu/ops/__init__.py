@@ -7,4 +7,11 @@ their XLA-composed equivalents, exact to f32-accumulation tolerance, with
 ``interpret=True`` fallbacks so every kernel is CI-testable on CPU.
 """
 
-from distributed_tensorflow_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from distributed_tensorflow_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_block,
+)
+from distributed_tensorflow_tpu.ops.pointwise_conv import (  # noqa: F401
+    pointwise_conv,
+    pointwise_matmul,
+)

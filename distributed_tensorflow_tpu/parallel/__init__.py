@@ -21,10 +21,14 @@ from distributed_tensorflow_tpu.parallel.pipeline import (  # noqa: F401
 from distributed_tensorflow_tpu.parallel.moe import (  # noqa: F401
     expert_param_specs,
     moe_apply,
+    moe_apply_a2a,
     stack_expert_params,
     switch_route,
 )
 from distributed_tensorflow_tpu.parallel.ring_attention import (  # noqa: F401
     dense_attention,
     ring_attention,
+)
+from distributed_tensorflow_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
 )
