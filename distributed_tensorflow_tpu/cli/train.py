@@ -108,20 +108,48 @@ def make_lr_schedule(cfg: WorkloadConfig) -> optax.Schedule:
     raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
 
 
+def _decay_mask(params):
+    """AdamW decoupled-weight-decay mask: the canonical BERT recipe
+    (google-research/bert AdamWeightDecayOptimizer exclude_from_weight_decay)
+    applies decay to weight matrices/embeddings only — LayerNorm/BatchNorm
+    scales and every bias are excluded. Name- and rank-based: 1-D leaves
+    (biases, norm scales) never decay; nor does anything named like a bias
+    (MoE expert bias stacks are 2-D) or living under a norm module."""
+
+    def decays(path, leaf) -> bool:
+        names = tuple(
+            str(p.key) for p in path if isinstance(p, jax.tree_util.DictKey)
+        )
+        last = names[-1] if names else ""
+        if leaf.ndim < 2 or "bias" in last or last in ("experts_b1", "experts_b2"):
+            return False
+        norm_mod = any(
+            n == "ln" or n.endswith("_ln") or n.endswith("_bn")
+            or "LayerNorm" in n or "BatchNorm" in n
+            for n in names
+        )
+        return not norm_mod
+
+    return jax.tree_util.tree_map_with_path(decays, params)
+
+
 def _make_tx(cfg: WorkloadConfig) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    # Global-norm clipping (cfg.clip_norm) is deliberately NOT chained here:
+    # optax.clip_by_global_norm inside the shard_mapped step sees per-shard
+    # slices of sharded params and would clip with a different scale on each
+    # shard (desynchronizing replicated leaves). The engine applies the
+    # spec-aware clip instead — see make_train_step(clip_norm=...).
     schedule = make_lr_schedule(cfg)
     if cfg.optimizer == "adamw":
-        tx = optax.adamw(schedule, weight_decay=cfg.weight_decay)
+        tx = optax.adamw(
+            schedule, weight_decay=cfg.weight_decay, mask=_decay_mask
+        )
     elif cfg.optimizer == "adam":
         tx = optax.adam(schedule)
     elif cfg.momentum:
         tx = optax.sgd(schedule, momentum=cfg.momentum)
     else:
         tx = optax.sgd(schedule)
-    if cfg.clip_norm > 0:
-        # Clip BEFORE the optimizer update (the canonical BERT/large-batch
-        # recipe): global-norm clipping over the full (already psum'd) tree.
-        tx = optax.chain(optax.clip_by_global_norm(cfg.clip_norm), tx)
     return tx, schedule
 
 
@@ -504,7 +532,9 @@ def _presets() -> dict[str, WorkloadConfig]:
             num_steps=10000,
             learning_rate=1e-4,
             # The canonical BERT pretraining recipe: AdamW with decoupled
-            # weight decay + global-norm clipping at 1.0.
+            # weight decay (masked off LayerNorm scales and all biases —
+            # _decay_mask) + spec-aware global-norm clipping at 1.0
+            # (applied inside the step; see make_train_step clip_norm).
             optimizer="adamw",
             weight_decay=0.01,
             clip_norm=1.0,
@@ -595,6 +625,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         staleness=cfg.staleness if cfg.mode == "stale" else 0,
         batch_spec=pieces["batch_spec"],
         state_specs=state_specs,
+        clip_norm=cfg.clip_norm,
     )
 
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None

@@ -178,8 +178,19 @@ class BertSelfAttention(nn.Module):
         impl = cfg.attn_impl
         if impl == "auto":
             # Measured crossover (docs/PERF.md r3): the Pallas kernel wins
-            # from L ~ 256 up; below, one fused dense matmul is faster.
-            impl = "flash" if l >= 256 else "dense"
+            # from L ~ 256 up; below, one fused dense matmul is faster. The
+            # decision length is the one the inner attention actually sees:
+            # the local shard for the ring (its inner runs per L_local
+            # block), but the full gathered sequence for Ulysses (its inner
+            # runs over L = l * ring_size after the all-to-alls).
+            eff_l = l
+            if (
+                cfg.seq_axis is not None
+                and cfg.sp_impl == "ulysses"
+                and _axis_bound(cfg.seq_axis)
+            ):
+                eff_l = l * lax.axis_size(cfg.seq_axis)
+            impl = "flash" if eff_l >= 256 else "dense"
         if cfg.seq_axis is not None:
             if cfg.sp_impl == "ulysses":
                 from distributed_tensorflow_tpu.parallel.ulysses import (
@@ -377,14 +388,14 @@ class BertModel(nn.Module):
         cfg = self.cfg
         self.embeddings = BertEmbeddings(cfg)
         if cfg.pipeline_axis is not None or cfg.pipeline_parallel > 1:
-            if (
-                cfg.seq_axis is not None
-                or cfg.model_parallel > 1
-                or cfg.moe_experts
-            ):
+            if cfg.seq_axis is not None or cfg.moe_experts:
+                # pp x tp IS supported (stage-sharded stack whose layers are
+                # additionally Megatron-sharded — bert_param_specs composes
+                # the specs, the engine's per-leaf contract divides by both
+                # axis factors; tests/test_bert_pp.py pins the trajectory).
                 raise NotImplementedError(
-                    "pipeline parallelism composes with plain DP only for "
-                    "now; unset seq_axis/model_parallel/moe_experts"
+                    "pipeline parallelism composes with dp and tp only for "
+                    "now; unset seq_axis/moe_experts"
                 )
             if cfg.num_layers % cfg.pipeline_parallel:
                 raise ValueError(
@@ -627,7 +638,15 @@ def bert_param_specs(
         )
         # Stacked encoder (pipeline config): every leaf under "encoder"
         # carries a leading [num_layers] dim sharded over the pipeline axis.
+        # TP/EP rules compose — the per-layer spec slots in behind the
+        # stacking dim (e.g. a stacked Q kernel [L, H, heads, hd] gets
+        # P("pipeline", None, "model", None)), so one leaf shards over both
+        # axes and the engine's per-leaf grad contract scales by each.
         if pipeline_axis is not None and "encoder" in names:
+            for suffix, spec in rules:
+                if names[-len(suffix):] == suffix:
+                    inner = tuple(spec) + (None,) * (leaf.ndim - 1 - len(spec))
+                    return P(pipeline_axis, *inner)
             return P(pipeline_axis, *(None,) * (leaf.ndim - 1))
         for suffix, spec in rules:
             if names[-len(suffix):] == suffix:

@@ -42,6 +42,31 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _fit_block(default: int, l: int) -> int:
+    """Largest block size <= default that divides l (lane-friendly steps).
+
+    Ring/Ulysses shard lengths are not always powers of two (e.g. a ring
+    shard of L_local = 384 fits 192-blocks): clamping to the default and
+    demanding divisibility would reject valid geometries the einsum inner
+    handles. Only multiple-of-8 blocks are accepted (the sublane floor);
+    a length with no such divisor still raises — silently falling back to
+    one l-sized block would defeat the blocking for large shards (a
+    [l, l] f32 score tile in VMEM) instead of surfacing the geometry error.
+    """
+    b = min(default, l)
+    if b >= 8 and l % b == 0:
+        return b
+    b -= b % 8
+    while b >= 8 and l % b:
+        b -= 8
+    if b >= 8 and l % b == 0:
+        return b
+    raise ValueError(
+        f"block length {l} has no multiple-of-8 divisor <= {default}; "
+        "pad the shard or pick a different ring size"
+    )
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -321,11 +346,10 @@ def flash_attention_block(
     if interpret is None:
         interpret = _use_interpret()
     b, l, h, d = q.shape
-    block_q = min(block_q, max(l, 8))
-    block_k = min(block_k, max(l, 8))
-    if l % block_q or l % block_k:
-        raise ValueError(f"ring block length {l} must divide blocks "
-                         f"({block_q}, {block_k})")
+    # The ring streams fixed-length shards — no padding allowed here, so fit
+    # the blocks to the shard length instead (largest divisor <= default).
+    block_q = _fit_block(block_q, l)
+    block_k = _fit_block(block_k, l)
     if mask is None:
         mask = jnp.ones((b, l), bool)
 

@@ -86,9 +86,21 @@ def ring_attention(q, k, v, axis_name: str, mask=None, *, inner: str = "einsum")
 
     def one_block(carry, _):
         k_blk, v_blk, mask_blk, o, m, denom = carry
+        # Cast per block INSIDE the compute: the carry keeps storage dtype
+        # (bf16), so every ppermute hop moves half the bytes an f32 carry
+        # would — on a real ICI ring that halves SP communication. Forward
+        # math is unchanged (same f32 casts, applied post-hop). Backward:
+        # the astype VJP rounds each hop's dK/dV contribution to storage
+        # dtype before the scan accumulates it, so bf16 inputs see O(ring)
+        # accumulation rounding — the same contract as the flash inner
+        # (whose carry always kept storage dtype); pinned with gradient
+        # tolerance in tests/test_ring_attention.py::test_ring_bf16_inputs.
         s = (
             jnp.einsum(
-                "blhd,bkhd->bhlk", q32, k_blk, preferred_element_type=jnp.float32
+                "blhd,bkhd->bhlk",
+                q32,
+                k_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
             )
             * scale
         )
@@ -117,7 +129,7 @@ def ring_attention(q, k, v, axis_name: str, mask=None, *, inner: str = "einsum")
             mask_blk = lax.ppermute(mask_blk, axis_name, perm)
         return (k_blk, v_blk, mask_blk, o, m_new, denom), None
 
-    carry = (k.astype(jnp.float32), v.astype(jnp.float32), mask, o, m, denom)
+    carry = (k, v, mask, o, m, denom)
     carry, _ = lax.scan(one_block, carry, None, length=n)
     _, _, _, o, m, denom = carry
     # A row with zero attendable keys ends with denom 0 — define output 0.

@@ -65,6 +65,7 @@ def make_train_step(
     staleness: int = 0,
     batch_spec: P | None = None,
     state_specs: "TrainState | None" = None,
+    clip_norm: float = 0.0,
     donate: bool = True,
 ):
     """Build the compiled ``train_step(state, batch, rng) -> (state, metrics)``.
@@ -88,6 +89,15 @@ def make_train_step(
         (scaled 1/t for the psum-transpose factor), replicated leaves pmean
         their partial grads across that axis — verified against unsharded
         models in tests/test_bert_tp.py and tests/test_pipeline.py.
+      clip_norm: > 0 enables global-norm gradient clipping INSIDE the step.
+        Clipping must live here, not in an ``optax.clip_by_global_norm``
+        chained into ``tx``: inside shard_map each shard's grad leaves hold
+        only the local slice of model/pipeline/expert-sharded params, so an
+        optax-side "global" norm — and hence the clip scale — differs per
+        shard, and replicated leaves silently desynchronize across shards.
+        The engine computes the spec-aware global norm (sharded-leaf squared
+        norms psum'd over their sharding axes) and applies one identical
+        scale everywhere. Semantics match optax.clip_by_global_norm.
       donate: donate state buffers so params update in place in HBM.
     """
     if mode not in ("sync", "stale"):
@@ -205,8 +215,6 @@ def make_train_step(
             grads = apply_grads
             metrics["staleness"] = jnp.asarray(staleness, jnp.float32)
 
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
         shard_axes = tuple(
             a for a in ("model", "pipeline", "expert") if a in mesh.axis_names
         )
@@ -224,9 +232,19 @@ def make_train_step(
                 return s
 
             total = sum(jax.tree.leaves(jax.tree.map(_sq, grads, param_specs)))
-            metrics["grad_norm"] = jnp.sqrt(total)
+            grad_norm = jnp.sqrt(total)
         else:
-            metrics["grad_norm"] = coll.global_norm(grads)
+            grad_norm = coll.global_norm(grads)
+        if clip_norm > 0:
+            # Spec-aware global-norm clipping (see the docstring): one scale,
+            # identical on every shard, from the true global norm. Same
+            # trust-ratio form as optax.clip_by_global_norm.
+            scale = clip_norm / jnp.maximum(grad_norm, clip_norm)
+            grads = jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = grad_norm
 
         new_state = TrainState(
             step=state.step + 1,

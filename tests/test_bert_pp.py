@@ -191,9 +191,103 @@ def test_pp_param_specs_shard_only_encoder():
             assert not any(a == "pipeline" for a in s if a), (k, s)
 
 
-def test_pp_rejects_tp_and_sp_composition():
+def test_pp_tp_training_matches_sequential(devices8):
+    """dp x pp x tp — the canonical 3D transformer layout: stage-sharded
+    encoder whose BertLayers are also Megatron-sharded. The trajectory must
+    match the sequential unsharded model leaf-by-leaf (the stacked Q/K/V
+    kernels shard over BOTH the pipeline and model axes; the engine's
+    per-leaf contract divides by each axis factor)."""
+    init_cfg = BertConfig(**TINY)
+    seq_params = _init_seq(init_cfg)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+
+    mesh_dp = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    b_ref = mlm_device_batches(data, mesh_dp, 16, seed=3)
+    state_ref, m_ref = _run(mesh_dp, init_cfg, seq_params, b_ref, 3)
+
+    cfg3d = dataclasses.replace(
+        init_cfg,
+        pipeline_axis="pipeline",
+        pipeline_parallel=2,
+        pipeline_microbatches=4,
+        model_axis="model",
+        model_parallel=2,
+    )
+    pp_params = _stack_params(seq_params, init_cfg.num_layers)
+    mesh3d = build_mesh({"data": 2, "pipeline": 2, "model": 2})
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(pp_params, tx),
+        tx,
+        bert_param_specs(
+            pp_params, model_axis="model", pipeline_axis="pipeline"
+        ),
+    )
+    b3d = mlm_device_batches(data, mesh3d, 16, seed=3)
+    state3d, m3d = _run(
+        mesh3d,
+        cfg3d,
+        pp_params,
+        b3d,
+        3,
+        state_specs=specs,
+        batch_spec=bert_batch_specs(mesh3d),
+    )
+
+    assert np.isclose(float(m_ref["loss"]), float(m3d["loss"]), atol=1e-4), (
+        float(m_ref["loss"]),
+        float(m3d["loss"]),
+    )
+    assert np.isclose(
+        float(m_ref["grad_norm"]), float(m3d["grad_norm"]), rtol=1e-4
+    ), (float(m_ref["grad_norm"]), float(m3d["grad_norm"]))
+
+    got = jax.device_get(state3d.params)
+    ref = jax.device_get(state_ref.params)
+    stacked = got["bert"]["encoder"]["layer"]
+    for i in range(init_cfg.num_layers):
+        flat_ref = jax.tree_util.tree_leaves_with_path(ref["bert"][f"layer_{i}"])
+        flat_got = dict(
+            jax.tree_util.tree_leaves_with_path(_unstack(stacked, i))
+        )
+        for path, leaf in flat_ref:
+            np.testing.assert_allclose(
+                np.asarray(leaf),
+                np.asarray(flat_got[path]),
+                atol=5e-5,
+                err_msg=f"layer_{i} {jax.tree_util.keystr(path)}",
+            )
+    np.testing.assert_allclose(
+        np.asarray(ref["bert"]["embeddings"]["word"]["embedding"]),
+        np.asarray(got["bert"]["embeddings"]["word"]["embedding"]),
+        atol=5e-5,
+    )
+
+
+def test_pp_tp_param_specs_compose():
+    """Stacked encoder leaves matching a TP rule shard over BOTH axes."""
+    seq_params = _init_seq(BertConfig(**TINY))
+    pp_params = _stack_params(seq_params, 2)
+    specs = bert_param_specs(
+        pp_params, model_axis="model", pipeline_axis="pipeline"
+    )
+    flat = {
+        jax.tree_util.keystr(p): s
+        for p, s in jax.tree_util.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+        )
+    }
+    q = flat["['bert']['encoder']['layer']['attention']['query']['kernel']"]
+    assert q[0] == "pipeline" and "model" in tuple(q), q
+    out = flat["['bert']['encoder']['layer']['attention']['out']['kernel']"]
+    assert out[0] == "pipeline" and out[1] == "model", out
+    # LN leaves stay pipeline-only.
+    ln = flat["['bert']['encoder']['layer']['ln']['scale']"]
+    assert tuple(a for a in ln if a) == ("pipeline",), ln
+
+
+def test_pp_rejects_sp_and_moe_composition():
     for extra in (
-        dict(model_axis="model", model_parallel=2),
         dict(seq_axis="seq"),
         dict(moe_experts=2),
     ):

@@ -56,7 +56,16 @@ def _init_global(cfg):
     return jax.device_get(variables["params"])
 
 
-def _run(mesh, cfg_model, params, batches, n_steps, state_specs=None, batch_spec=None):
+def _run(
+    mesh,
+    cfg_model,
+    params,
+    batches,
+    n_steps,
+    state_specs=None,
+    batch_spec=None,
+    clip_norm=0.0,
+):
     tx = optax.adam(1e-3)
     state = place_state(create_train_state(params, tx), mesh, state_specs)
     step = make_train_step(
@@ -65,6 +74,7 @@ def _run(mesh, cfg_model, params, batches, n_steps, state_specs=None, batch_spec
         mesh,
         batch_spec=batch_spec,
         state_specs=state_specs,
+        clip_norm=clip_norm,
     )
     metrics = None
     for _ in range(n_steps):
@@ -120,6 +130,74 @@ def test_tp_training_matches_unsharded(devices8):
             np.asarray(leaf), np.asarray(got), atol=5e-5,
             err_msg=jax.tree_util.keystr(path),
         )
+
+
+def test_tp_clipping_matches_unsharded(devices8):
+    """Global-norm clipping under TP must use the SPEC-AWARE global norm.
+
+    An optax.clip_by_global_norm chained into tx would see only each shard's
+    local slice of model-sharded leaves, clip with a different scale per
+    shard, and silently desynchronize replicated leaves — the engine's
+    clip_norm path psums sharded-leaf squared norms over their axes first.
+    clip_norm is set well below the observed grad norm so clipping is
+    guaranteed active every step."""
+    init_cfg = BertConfig(**TINY)
+    params = _init_global(init_cfg)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+
+    mesh_dp = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    b_dp = mlm_device_batches(data, mesh_dp, 16, seed=3)
+    state_ref, m_ref = _run(mesh_dp, init_cfg, params, b_dp, 3, clip_norm=0.05)
+    # The clip must actually engage for this test to mean anything.
+    assert float(m_ref["grad_norm"]) > 0.05
+
+    mesh_tp = build_mesh({"data": 2, "model": 4})
+    tp_cfg = dataclasses.replace(init_cfg, model_axis="model", model_parallel=4)
+    specs = make_state_specs(
+        create_train_state(params, optax.adam(1e-3)),
+        optax.adam(1e-3),
+        bert_param_specs(params),
+    )
+    b_tp = mlm_device_batches(data, mesh_tp, 16, seed=3)
+    state_tp, m_tp = _run(
+        mesh_tp,
+        tp_cfg,
+        params,
+        b_tp,
+        3,
+        state_specs=specs,
+        batch_spec=bert_batch_specs(mesh_tp),
+        clip_norm=0.05,
+    )
+    assert np.isclose(float(m_ref["loss"]), float(m_tp["loss"]), atol=1e-4)
+    flat_ref = jax.tree_util.tree_leaves_with_path(jax.device_get(state_ref.params))
+    flat_tp = dict(jax.tree_util.tree_leaves_with_path(jax.device_get(state_tp.params)))
+    for path, leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_tp[path]), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_adamw_decay_mask_excludes_norms_and_biases():
+    """The canonical BERT recipe: weight decay on matrices/embeddings only."""
+    from distributed_tensorflow_tpu.cli.train import _decay_mask
+
+    params = _init_global(BertConfig(**TINY))
+    mask = _decay_mask(params)
+    flat = {
+        jax.tree_util.keystr(p): v
+        for p, v in jax.tree_util.tree_leaves_with_path(mask)
+    }
+    for k, v in flat.items():
+        if "bias" in k or "'ln'" in k or "mlm_ln" in k:
+            assert v is False, k
+    # Embeddings and attention/FFN kernels DO decay.
+    assert flat["['bert']['embeddings']['word']['embedding']"] is True
+    decayed = [k for k, v in flat.items() if v]
+    assert any("query" in k and "kernel" in k for k in decayed)
+    assert any("intermediate" in k and "kernel" in k for k in decayed)
+    assert not any("scale" in k for k in decayed)
 
 
 def test_tp_param_specs_cover_attention_and_ffn(devices8):
