@@ -76,6 +76,13 @@ class WorkloadConfig:
     data_dir: str = ""  # where to look for it; synthetic fallback otherwise
     augment: str = ""  # "" | "cifar" (pad-crop+flip) | "imagenet" (RRC+flip)
     native_input: bool = True  # use the C++ pipeline when buildable
+    # > 0: pre-place this many batches in HBM and cycle them — the training
+    # loop then runs at device rate with ZERO host->device transfers in the
+    # hot path. For throughput/trajectory runs on tunneled or feed-bound
+    # hosts (the r3 ImageNet runs were host-bound at ~0.2 steps/s); the
+    # model revisits the pool every N steps, so it is NOT for convergence
+    # claims beyond pool-sized epochs.
+    device_pool: int = 0
     log_every: int = 50
     ckpt_every: int = 0
 
@@ -293,6 +300,14 @@ def _build_bert_workload(cfg_kwargs: dict):
             tp = mesh.shape.get("model", 1)
             ep = mesh.shape.get("expert", 1)
             pp = mesh.shape.get("pipeline", 1)
+            # GShard token-sharded layout: the expert axis carries batch rows
+            # (expert group ≡ data group), so non-MoE compute shards over it
+            # too and the MoE a2a routes straight from the local slice.
+            expert_sharded = cfg.moe_dispatch == "sharded" and ep > 1
+            if cfg.moe_dispatch == "sharded" and ep <= 1:
+                raise ValueError(
+                    "--moe-dispatch=sharded requires --expert-parallel > 1"
+                )
             kwargs = dict(cfg_kwargs)
             if cfg.bert_layers:
                 kwargs["num_layers"] = cfg.bert_layers
@@ -308,11 +323,18 @@ def _build_bert_workload(cfg_kwargs: dict):
                         f"--moe-experts={cfg.moe_experts} not divisible by "
                         f"--expert-parallel={ep}"
                     )
-                # Init with the GLOBAL expert count (expert_parallel=1).
+                # Init with the GLOBAL expert count (expert_parallel=1) and
+                # the replicated dispatch — "sharded" needs a bound expert
+                # axis and an expert-sharded batch, neither of which exists
+                # at init time; the param tree is dispatch-independent.
                 init_cfg = dataclasses.replace(
                     init_cfg,
                     moe_experts=cfg.moe_experts,
-                    moe_dispatch=cfg.moe_dispatch,
+                    moe_dispatch=(
+                        "replicated"
+                        if cfg.moe_dispatch == "sharded"
+                        else cfg.moe_dispatch
+                    ),
                 )
             model_cfg = init_cfg
             if seq_parallel:
@@ -325,7 +347,10 @@ def _build_bert_workload(cfg_kwargs: dict):
                 )
             if ep > 1:
                 model_cfg = dataclasses.replace(
-                    model_cfg, expert_axis="expert", expert_parallel=ep
+                    model_cfg,
+                    expert_axis="expert",
+                    expert_parallel=ep,
+                    moe_dispatch=cfg.moe_dispatch or "replicated",
                 )
             if pp > 1:
                 # Per-DP-shard rows must split into the GPipe microbatches.
@@ -420,6 +445,7 @@ def _build_bert_workload(cfg_kwargs: dict):
                     mesh,
                     cfg.global_batch,
                     seq_sharded=bool(seq_parallel),
+                    expert_sharded=expert_sharded,
                     seed=900_001,
                 )
                 for _ in range(n_batches):
@@ -444,11 +470,14 @@ def _build_bert_workload(cfg_kwargs: dict):
                     mesh,
                     cfg.global_batch,
                     seq_sharded=bool(seq_parallel),
+                    expert_sharded=expert_sharded,
                     seed=1,
                     start_step=start_step,
                 ),
                 "batch_spec": bert_batch_specs(
-                    mesh, seq_sharded=bool(seq_parallel)
+                    mesh,
+                    seq_sharded=bool(seq_parallel),
+                    expert_sharded=expert_sharded,
                 ),
                 "metric_fn": make_bert_eval_metrics(model),
                 "eval_batches": eval_batches,
@@ -635,6 +664,26 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     # Resume-correct stream: batches start at N, not 0 (the fix for the
     # reference-era replay-on-restart).
     batches = pieces["batches"](start)
+    if cfg.device_pool > 0:
+        # Device-resident pool: materialize the first N batches in HBM once
+        # and cycle — the host (and on this platform, the tunnel) leaves the
+        # hot loop entirely. Safe to reuse batches across steps: the train
+        # step donates only the state, never the batch.
+        src = batches
+        pool = [next(src) for _ in range(cfg.device_pool)]
+        jax.block_until_ready(pool[-1])
+        close_src = getattr(src, "close", None)
+        if close_src is not None:
+            close_src()
+        if jax.process_index() == 0:
+            logging.info(
+                "device_pool=%d batches resident in HBM; host feed is out "
+                "of the hot loop", cfg.device_pool,
+            )
+
+        import itertools
+
+        batches = itertools.cycle(pool)
 
     evaluate = None
     if args.eval_every and pieces.get("metric_fn") and pieces.get("eval_batches"):
@@ -715,9 +764,11 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--moe-experts", type=int, default=-1,
                         help="switch-MoE FFN with N experts (BERT; 0 = dense FFN)")
     parser.add_argument("--moe-dispatch", default="",
-                        choices=["", "replicated", "alltoall"],
-                        help="MoE dispatch layout (alltoall = token-sharded "
-                        "GShard capacity-buffer exchange)")
+                        choices=["", "replicated", "alltoall", "sharded"],
+                        help="MoE dispatch layout: alltoall = capacity-buffer "
+                        "exchange over replicated tokens; sharded = the "
+                        "production GShard layout (batch sharded over the "
+                        "expert axis, zero replicated non-MoE compute)")
     parser.add_argument("--pipeline-parallel", type=int, default=-1,
                         help="pipeline-stage axis size for the BERT encoder "
                         "(GPipe schedule; 0 disables)")
@@ -740,6 +791,10 @@ def main(argv: list[str] | None = None):
                         help="directory with real dataset files (synthetic fallback)")
     parser.add_argument("--no-native-input", action="store_true",
                         help="force the numpy input path (skip the C++ pipeline)")
+    parser.add_argument("--device-pool", type=int, default=0,
+                        help="pre-place N batches in HBM and cycle them "
+                        "(device-rate runs on feed-bound hosts; revisits "
+                        "the pool every N steps)")
     parser.add_argument("--eval-every", type=int, default=0,
                         help="run held-out eval every N steps (0 = off)")
     parser.add_argument("--eval-batches", type=int, default=8,
@@ -800,6 +855,8 @@ def main(argv: list[str] | None = None):
         overrides["data_dir"] = args.data_dir
     if args.no_native_input:
         overrides["native_input"] = False
+    if args.device_pool:
+        overrides["device_pool"] = args.device_pool
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     state, last = run(cfg, args)

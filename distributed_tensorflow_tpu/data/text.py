@@ -270,17 +270,25 @@ class TextCorpusMLM:
         }
 
 
-def bert_batch_specs(mesh, *, seq_sharded: bool = False) -> dict:
+def bert_batch_specs(
+    mesh, *, seq_sharded: bool = False, expert_sharded: bool = False
+) -> dict:
     """Per-leaf PartitionSpecs for a BERT batch (pass as train-step batch_spec).
 
     [B, L] leaves shard batch over the DP axes and (optionally) sequence over
     ``"seq"``; the [B] nsp label only shards the batch dim.
+    ``expert_sharded=True`` additionally splits the batch dim over the
+    ``"expert"`` axis — the GShard token-sharded MoE layout
+    (``moe_dispatch="sharded"``), where the expert axis carries data like a
+    DP axis and NOTHING in the model is redundantly replicated across it.
     """
     from jax.sharding import PartitionSpec as P
 
     from distributed_tensorflow_tpu.parallel.mesh import data_axes
 
     dp = data_axes(mesh)
+    if expert_sharded and "expert" in mesh.axis_names:
+        dp = dp + ("expert",)
     dp_spec = dp if dp else None
     seq = "seq" if (seq_sharded and "seq" in mesh.axis_names) else None
     spec_2d = P(dp_spec, seq)
@@ -300,16 +308,19 @@ def mlm_device_batches(
     global_batch: int,
     *,
     seq_sharded: bool = False,
+    expert_sharded: bool = False,
     seed: int = 0,
     start_step: int = 0,
 ):
     """Infinite iterator of placed BERT batches.
 
     ``seq_sharded=True`` additionally shards the [B, L] leaves' second dim
-    over the mesh's ``"seq"`` axis (for ring-attention runs). Each host
-    generates ONLY its local slice (per-host generator streams seeded by
-    ``(step, process_index)``) — no redundant global-batch work in the hot
-    loop.
+    over the mesh's ``"seq"`` axis (for ring-attention runs);
+    ``expert_sharded=True`` splits the batch dim over ``"expert"`` too (the
+    GShard token-sharded MoE layout — see :func:`bert_batch_specs`). Each
+    host generates ONLY its local slice (per-host generator streams seeded
+    by ``(step, process_index)``) — no redundant global-batch work in the
+    hot loop.
     """
     import numpy as np
     import jax
@@ -318,8 +329,12 @@ def mlm_device_batches(
     from distributed_tensorflow_tpu.parallel.mesh import data_axes, local_batch_size
 
     dp = data_axes(mesh)
+    if expert_sharded and "expert" in mesh.axis_names:
+        dp = dp + ("expert",)
     dp_spec = dp if dp else None
-    local_b = local_batch_size(global_batch, mesh)
+    local_b = local_batch_size(
+        global_batch, mesh, extra_axes=("expert",) if expert_sharded else ()
+    )
     seq = "seq" if (seq_sharded and "seq" in mesh.axis_names) else None
     spec_2d = NamedSharding(mesh, P(dp_spec, seq))
     spec_1d = NamedSharding(mesh, P(dp_spec))

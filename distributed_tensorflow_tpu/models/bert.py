@@ -82,10 +82,14 @@ class BertConfig:
     expert_axis: str | None = None
     expert_parallel: int = 1
     # "replicated": every expert shard routes all tokens, partial outputs
-    # psum (exact global capacity order). "alltoall": token-sharded
-    # capacity-buffer dispatch over the expert axis (GShard layout,
-    # parallel/moe.py moe_apply_a2a) — the scalable choice, and the one
-    # that composes with sequence parallelism.
+    # psum (exact global capacity order). "alltoall": capacity-buffer
+    # dispatch over the expert axis with tokens replicated outside the MoE
+    # (parallel/moe.py moe_apply_a2a). "sharded": the PRODUCTION GShard
+    # layout — the batch itself shards over the expert axis (expert group ≡
+    # data group), so attention/embeddings/heads compute 1/E of the rows
+    # per shard (zero redundant non-MoE compute) and the a2a routes from
+    # the local slice with no trailing all_gather. Requires the loaders'
+    # expert_sharded batch layout (data/text.py bert_batch_specs).
     moe_dispatch: str = "replicated"
     # Pipeline parallelism (GPipe schedule, parallel/pipeline.py): with
     # ``pipeline_axis`` set the encoder's params are a stacked
@@ -250,8 +254,13 @@ class MoeFfn(nn.Module):
         # over "model": column-parallel w1/b1, row-parallel w2 with the
         # partial outputs psum'd after dispatch; b2 enters as b2/tp on each
         # shard so the psum reconstructs it exactly once).
-        if cfg.moe_dispatch not in ("replicated", "alltoall"):
+        if cfg.moe_dispatch not in ("replicated", "alltoall", "sharded"):
             raise ValueError(f"unknown moe_dispatch {cfg.moe_dispatch!r}")
+        if cfg.moe_dispatch == "sharded" and cfg.expert_parallel <= 1:
+            raise ValueError(
+                "moe_dispatch='sharded' routes from the expert-sharded batch "
+                "— it requires expert_parallel > 1"
+            )
         b, l, h = x.shape
         tp = cfg.model_parallel
         ff_local = cfg.intermediate_size // tp
@@ -298,7 +307,24 @@ class MoeFfn(nn.Module):
             valid=None if mask is None else mask.reshape(b * l),
         )
         experts = {"w1": w1, "b1": b1, "w2": w2, "b2": b2}
-        if use_a2a:
+        if cfg.moe_dispatch == "sharded":
+            # Production GShard layout (expert group ≡ data group): the
+            # batch arrives ALREADY sharded over the expert axis — b here is
+            # the local slice, attention/embeddings/heads computed it 1/E-
+            # sized, and the a2a routes straight from it. Per-group aux
+            # statistics (no expert psum): each group's aux is a complete
+            # loss term that the engine's DP-mean averages like the rest.
+            y, aux = moe_apply_a2a(
+                expert_fn,
+                experts,
+                logits,
+                tokens,
+                axis_name=cfg.expert_axis,
+                stats_axes=stats_axes,
+                tokens_sharded=True,
+                **apply_kwargs,
+            )
+        elif use_a2a:
             y, aux = moe_apply_a2a(
                 expert_fn,
                 experts,

@@ -136,14 +136,64 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+class _Conv1x1Kernel(nn.Module):
+    """Kernel-param holder for the fused conv+BN path — declares exactly the
+    ``kernel`` leaf ``nn.Conv(features, (1,1), use_bias=False)`` would, so
+    the param tree (and any checkpoint) is identical across backends."""
+
+    cin: int
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "kernel",
+            nn.initializers.he_normal(),
+            (1, 1, self.cin, self.features),
+            jnp.float32,
+        )
+
+
+class _BNParamsStats(nn.Module):
+    """BatchNorm param/stat holder matching ``nn.BatchNorm``'s tree exactly
+    (params ``scale``/``bias``; ``batch_stats`` collection ``mean``/``var``).
+    First call (no args) reads; second call folds the fused op's batch stats
+    into the running averages with flax's momentum rule."""
+
+    features: int
+    momentum: float = 0.9
+    scale_init: Callable = nn.initializers.ones_init()
+
+    @nn.compact
+    def __call__(self, batch_mean=None, batch_var=None):
+        f = self.features
+        scale = self.param("scale", self.scale_init, (f,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (f,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((f,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((f,), jnp.float32)
+        )
+        if batch_mean is not None and not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * batch_mean
+            ra_var.value = m * ra_var.value + (1 - m) * batch_var
+        return scale, bias
+
+
 class BottleneckBlock(nn.Module):
     """1x1 down / 3x3 / 1x1 up (x4) bottleneck block (ImageNet ResNets).
 
     ``conv1x1`` (when set) handles the three pointwise convs — the ResNet
     wires :class:`PointwiseConv` with the Pallas backward here on TPU.
-    Explicit layer names keep the param tree identical to the historical
-    auto-named ``nn.Conv`` layout (Conv_0/Conv_1/Conv_2/proj), so
-    checkpoints are interchangeable across backends.
+    ``fused`` + ``train`` switch qualified 1x1+BN(+ReLU) units onto the
+    fully-fused Pallas backward (ops/fused_conv_bn.py — the r4 kernel
+    family that absorbs the ReLU mask and BN-backward reductions XLA fuses
+    into its dgrad convs, docs/PERF.md r3 conclusion). Explicit layer names
+    keep the param tree identical to the historical auto-named ``nn.Conv``
+    layout (Conv_0/BatchNorm_0/...), so checkpoints are interchangeable
+    across all backends.
     """
 
     filters: int
@@ -151,30 +201,65 @@ class BottleneckBlock(nn.Module):
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = nn.BatchNorm
     conv1x1: ModuleDef | None = None
+    fused: bool = False
+    train: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     def _c1(self, features: int, strides: int = 1, name: str | None = None):
         if self.conv1x1 is not None:
             return self.conv1x1(features, strides=strides, name=name)
         return self.conv(features, (1, 1), strides=(strides,) * 2, name=name)
 
+    def _unit(self, x, features, strides, conv_name, bn_name, relu, zero_bn):
+        """One conv1x1 -> BN (-> ReLU) unit; fused when shapes qualify."""
+        from distributed_tensorflow_tpu.ops.fused_conv_bn import (
+            conv1x1_bn_act,
+            fused_supported,
+        )
+
+        b, h, w, cin = x.shape
+        # Ceil division: x[:, ::s, ::s] keeps ceil(h/s) rows, not floor.
+        m = b * (-(-h // strides)) * (-(-w // strides))
+        scale_init = (
+            nn.initializers.zeros_init() if zero_bn
+            else nn.initializers.ones_init()
+        )
+        if self.fused and self.train and fused_supported(m, cin, features):
+            kernel = _Conv1x1Kernel(cin, features, name=conv_name)()
+            bn = _BNParamsStats(features, scale_init=scale_init, name=bn_name)
+            scale, bias = bn()
+            a, bm, bv = conv1x1_bn_act(
+                x.astype(self.dtype),
+                kernel,
+                scale,
+                bias,
+                relu=relu,
+                strides=strides,
+            )
+            bn(bm, bv)  # running-average update (flax momentum rule)
+            return a
+        y = self._c1(features, strides=strides, name=conv_name)(x)
+        kw = {"scale_init": scale_init} if zero_bn else {}
+        y = self.norm(name=bn_name, **kw)(y)
+        return nn.relu(y) if relu else y
+
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self._c1(self.filters, name="Conv_0")(x)
-        y = self.norm()(y)
-        y = nn.relu(y)
+        y = self._unit(x, self.filters, 1, "Conv_0", "BatchNorm_0",
+                       relu=True, zero_bn=False)
         y = self.conv(
             self.filters, (3, 3), strides=(self.strides,) * 2, name="Conv_1"
         )(y)
-        y = self.norm()(y)
+        y = self.norm(name="BatchNorm_1")(y)
         y = nn.relu(y)
-        y = self._c1(self.filters * 4, name="Conv_2")(y)
-        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        y = self._unit(y, self.filters * 4, 1, "Conv_2", "BatchNorm_2",
+                       relu=False, zero_bn=True)
         if residual.shape != y.shape:
-            residual = self._c1(
-                self.filters * 4, strides=self.strides, name="proj"
-            )(residual)
-            residual = self.norm(name="proj_bn")(residual)
+            residual = self._unit(
+                residual, self.filters * 4, self.strides, "proj", "proj_bn",
+                relu=False, zero_bn=False,
+            )
         return nn.relu(y + residual)
 
 
@@ -244,14 +329,16 @@ class ResNet(nn.Module):
     stem: str = "imagenet"
     stem_s2d: bool = True
     remat: bool = False  # rematerialize blocks: trade (cheap) FLOPs for HBM
-    # 1x1-conv path: "conv" (default) = nn.Conv everywhere — measured
-    # fastest at the step level. "pallas" = custom-vjp 1x1s with Pallas
-    # dgrad kernels (ops/pointwise_conv.py): 3-5x faster per-op on K>=128
-    # shapes but a net step-level LOSS (56.5 vs 47.9 ms/step at b=128),
-    # because breaking the graph un-fuses XLA's relu/BN-backward epilogues
-    # from the surrounding convs — the full study is in docs/PERF.md r3.
-    # Kept as a benchmarked option and the substrate for future fused
-    # (conv+BN+relu)-backward kernels.
+    # 1x1-conv path: "conv" (default) = nn.Conv everywhere — the fastest
+    # UNFUSED configuration. "pallas" = custom-vjp 1x1s with Pallas dgrad
+    # kernels (ops/pointwise_conv.py): 3-5x faster per-op on K>=128 shapes
+    # but a net step-level LOSS (56.5 vs 47.9 ms/step at b=128), because
+    # breaking the graph un-fuses XLA's relu/BN-backward epilogues from the
+    # surrounding convs — the full study is in docs/PERF.md r3. "fused" =
+    # the r4 answer: whole conv1x1+BN(+ReLU) units with a fully-fused
+    # Pallas backward that ABSORBS those epilogues (mask + BN-bwd
+    # reductions ride the dgrad/wgrad kernels, ops/fused_conv_bn.py);
+    # C=64 shapes (stage 1) keep the XLA path per the layout study.
     pw_backend: str = "conv"
     dtype: jnp.dtype = jnp.float32
 
@@ -306,7 +393,16 @@ class ResNet(nn.Module):
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
-                kwargs = {"conv1x1": conv1x1} if self.block is BottleneckBlock else {}
+                kwargs = (
+                    {
+                        "conv1x1": conv1x1,
+                        "fused": self.pw_backend == "fused",
+                        "train": train,
+                        "dtype": self.dtype,
+                    }
+                    if self.block is BottleneckBlock
+                    else {}
+                )
                 x = block_cls(
                     self.num_filters * 2**i,
                     strides=strides,

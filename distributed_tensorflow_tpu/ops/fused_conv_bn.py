@@ -1,0 +1,314 @@
+r"""Fused 1x1-conv + BatchNorm (+ReLU) with a fully-fused Pallas backward.
+
+The r4 kernel family docs/PERF.md:97-110 calls for — the one remaining path
+toward the measured ~0.30 MFU ceiling for ResNet-50 on this chip (VERDICT r3
+Missing #1). The r3 campaign proved per-op conv efficiency was never the
+binding constraint: three Pallas dgrad strategies each beat XLA 3-5x per-op
+and each LOST at the step level, because XLA fuses the ReLU mask and the two
+BatchNorm-backward per-channel reductions into its dgrad convs, and an
+opaque kernel evicted those riders into standalone passes. This module
+absorbs them: the backward takes the extra operands (the saved conv output,
+the per-channel BN stats) and emits the two reductions as extra outputs, so
+NOTHING falls out of the fusion when the Pallas op replaces it.
+
+Forward (XLA-land on purpose — its fused producer chains already saturate
+bandwidth, docs/PERF.md r3):
+
+    z  = x @ W                  (1x1 conv as matmul, bf16, MXU)
+    mu, var = batch stats(z)    (f32, fast-variance form like flax BN)
+    a  = relu?(gamma * (z - mu) * rsqrt(var+eps) + beta)
+
+Backward (two Pallas kernels, one logical pass-pair over [M, N]):
+
+    g   = dA * mask             mask = (gamma*x_hat+beta > 0) recomputed
+    s1  = sum_m g               \  kernel 1: one streaming read of dA, z
+    s2  = sum_m g * x_hat       /  (x_hat recomputed from z and stats)
+    dz  = gamma*inv * (g - s1/M - x_hat*s2/M)      per-element, in-register
+    dx  = dz @ W^T              \  kernel 2: dz recomputed per tile feeds
+    dW  = x^T @ dz              /  BOTH matmuls — dz is never materialized
+    dgamma = s2, dbeta = s1     (free riders of kernel 1)
+
+HBM traffic: 4 reads of [M,N] + 1 read/1 write of [M,K] vs the unfused
+XLA chain's ~7 [M,N] passes + the same [M,K] traffic — and unlike the r3
+kernels, zero evicted epilogue work. Layouts follow the r3 measurement:
+activations with C >= 128 flatten in H,W,B,C order (a bitcast at the Pallas
+boundary); C = 64 tensors would force relayout copies, so those shapes are
+gated off to the plain path (see :func:`fused_supported`).
+
+The running-stat bookkeeping (flax ``batch_stats`` collection) lives in
+models/resnet.py's ``FusedConvBN`` module; this file is pure function + VJP.
+
+Reference parity: replaces the reference's cuDNN conv + fused-BN training
+blocks inside its ResNet-50/Inception workloads (SURVEY.md §2 rows); math is
+identical to ``nn.Conv(f,(1,1))`` + ``nn.BatchNorm`` + relu up to f32
+reduction order (pinned by tests/test_fused_conv_bn.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MAX_KN = 4096
+# Per-tile VMEM budget (bytes) for the apply kernel's streamed operands —
+# double-buffered pipelines must leave room for W [K,N] and the dW [K,N] f32
+# accumulator, which stay resident.
+_TILE_BYTES = 2 * 1024 * 1024
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _tile_m(m: int, k: int, n: int) -> int | None:
+    """Largest multiple-of-16 divisor of m whose tile working set fits."""
+    # Streamed per tile: dA, z [TM, N] bf16 + x, dx [TM, K] bf16.
+    cap = max(16, _TILE_BYTES // max(1, 2 * (2 * n + 2 * k)))
+    for t in range(min(1024, cap, m) & ~15, 15, -16):
+        if m % t == 0:
+            return t
+    return None
+
+
+def fused_supported(m: int, k: int, n: int) -> bool:
+    """Shapes the fused backward handles with bitcast boundaries.
+
+    Both channel dims must be >= 128 (C = 64 activations live in XLA's
+    B-minor layout; the flatten would materialize a relayout — the measured
+    step-level loss of the r3 generic kernels) and the M dim must tile.
+    ``FUSED_CONV_BN_MAXM`` / ``FUSED_CONV_BN_MINM`` (env) bound the M range
+    that fuses — the per-stage bisection/tuning knob (M is stage-unique in
+    ResNet-50: 401408 / 100352 / 25088 / 6272 at b=128).
+    """
+    import os
+
+    maxm = int(os.environ.get("FUSED_CONV_BN_MAXM", "0") or 0)
+    minm = int(os.environ.get("FUSED_CONV_BN_MINM", "0") or 0)
+    if (maxm and m > maxm) or (minm and m < minm):
+        return False
+    return (
+        128 <= k <= _MAX_KN
+        and 128 <= n <= _MAX_KN
+        and _tile_m(m, k, n) is not None
+    )
+
+
+# ---------------------------------------------------------------------------
+# Kernels. Per-channel constants ride as one [8, N] f32 ref:
+#   row 0: mu, 1: inv (rsqrt(var+eps)), 2: gamma, 3: beta,
+#   row 4: s1/M, 5: s2/M (apply kernel only; zero for the reduce kernel).
+# ---------------------------------------------------------------------------
+
+
+def _g_xhat(da_ref, z_ref, c_ref, relu: bool):
+    da = da_ref[:].astype(jnp.float32)
+    xh = (z_ref[:].astype(jnp.float32) - c_ref[0, :]) * c_ref[1, :]
+    if relu:
+        mask = (c_ref[2, :] * xh + c_ref[3, :]) > 0.0
+        g = jnp.where(mask, da, 0.0)
+    else:
+        g = da
+    return g, xh
+
+
+def _reduce_kernel(da_ref, z_ref, c_ref, s1_ref, s2_ref, *, relu):
+    g, xh = _g_xhat(da_ref, z_ref, c_ref, relu)
+    p1 = jnp.sum(g, axis=0, keepdims=True)
+    p2 = jnp.sum(g * xh, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        s1_ref[:] = p1
+        s2_ref[:] = p2
+
+    @pl.when(pl.program_id(0) != 0)
+    def _acc():
+        s1_ref[:] = s1_ref[:] + p1
+        s2_ref[:] = s2_ref[:] + p2
+
+
+def _apply_kernel(da_ref, z_ref, x_ref, w_ref, c_ref, dx_ref, dw_ref, *, relu):
+    g, xh = _g_xhat(da_ref, z_ref, c_ref, relu)
+    dz = (c_ref[2, :] * c_ref[1, :]) * (g - c_ref[4, :] - xh * c_ref[5, :])
+    dz_lo = dz.astype(w_ref.dtype)
+    # dx[TM, K] = dz[TM, N] @ W[K, N]^T — contract N, no explicit transpose.
+    dx_ref[:] = lax.dot_general(
+        dz_lo,
+        w_ref[:],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dx_ref.dtype)
+    # dW[K, N] += x[TM, K]^T @ dz[TM, N] — sequential-grid accumulation.
+    part = lax.dot_general(
+        x_ref[:],
+        dz_lo,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[:] = part
+
+    @pl.when(pl.program_id(0) != 0)
+    def _acc():
+        dw_ref[:] = dw_ref[:] + part
+
+
+def _pack_consts(mu, inv, gamma, beta, c1=None, c2=None):
+    n = mu.shape[0]
+    z = jnp.zeros((n,), jnp.float32)
+    rows = [mu, inv, gamma, beta, c1 if c1 is not None else z,
+            c2 if c2 is not None else z, z, z]
+    return jnp.stack([r.astype(jnp.float32) for r in rows])
+
+
+def _bn_bwd_reduce(da2, z2, consts, relu: bool, interpret: bool):
+    m, n = da2.shape
+    tm = _tile_m(m, 0, n) or m
+    s1, s2 = pl.pallas_call(
+        functools.partial(_reduce_kernel, relu=relu),
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(da2, z2, consts)
+    return s1[0], s2[0]
+
+
+def _bn_bwd_apply(da2, z2, x2, w2, consts, relu: bool, interpret: bool):
+    m, n = da2.shape
+    k = x2.shape[1]
+    tm = _tile_m(m, k, n)
+    dx, dw = pl.pallas_call(
+        functools.partial(_apply_kernel, relu=relu),
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, k), da2.dtype),
+            jax.ShapeDtypeStruct((k, n), jnp.float32),
+        ],
+        interpret=interpret,
+    )(da2, z2, x2, w2, consts)
+    return dx, dw
+
+
+# ---------------------------------------------------------------------------
+# The custom-VJP unit over flattened [M, C] views.
+# ---------------------------------------------------------------------------
+
+
+def _fwd_math(x2, w2, gamma, beta, relu: bool, eps: float):
+    z2 = jnp.dot(x2, w2)
+    zf = z2.astype(jnp.float32)
+    m = zf.shape[0]
+    mean = jnp.mean(zf, axis=0)
+    # Fast-variance form, matching flax BatchNorm's default.
+    var = jnp.mean(jnp.square(zf), axis=0) - jnp.square(mean)
+    inv = lax.rsqrt(var + eps)
+    y = (zf - mean) * (inv * gamma) + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x2.dtype), z2, mean, var, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused(x2, w2, gamma, beta, relu, eps, interpret):
+    a2, _, mean, var, _ = _fwd_math(x2, w2, gamma, beta, relu, eps)
+    return a2, mean, var
+
+
+def _fused_fwd(x2, w2, gamma, beta, relu, eps, interpret):
+    a2, z2, mean, var, inv = _fwd_math(x2, w2, gamma, beta, relu, eps)
+    return (a2, mean, var), (x2, w2, z2, mean, inv, gamma, beta)
+
+
+def _fused_bwd(relu, eps, interpret, res, cts):
+    # The mean/var outputs exist for running-stat bookkeeping only — their
+    # cotangents are dropped (stop-gradient semantics, same as flax's
+    # running averages; the batch-stat gradient paths through the
+    # NORMALIZATION are the s1/s2 terms below, which are exact).
+    da2, _, _ = cts
+    x2, w2, z2, mean, inv, gamma, beta = res
+    m = x2.shape[0]
+    consts = _pack_consts(mean, inv, gamma, beta)
+    s1, s2 = _bn_bwd_reduce(da2, z2, consts, relu, interpret)
+    consts = _pack_consts(mean, inv, gamma, beta, s1 / m, s2 / m)
+    dx2, dw = _bn_bwd_apply(da2, z2, x2, w2, consts, relu, interpret)
+    return dx2, dw.astype(w2.dtype), s2, s1
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def conv1x1_bn_act(
+    x4: jax.Array,
+    kernel: jax.Array,
+    gamma: jax.Array,
+    beta: jax.Array,
+    *,
+    relu: bool,
+    strides: int = 1,
+    eps: float = 1e-5,
+    interpret: bool | None = None,
+):
+    """1x1 conv + train-mode BatchNorm (+ReLU) with the fused Pallas backward.
+
+    Args:
+      x4: ``[B, H, W, K]`` activations (bf16 recommended).
+      kernel: ``[1, 1, K, N]`` or ``[K, N]`` conv kernel (cast to x4.dtype).
+      gamma, beta: BN scale/bias ``[N]`` (f32).
+      relu: apply ReLU after the BN (Conv_0/Conv_1 positions; the block's
+        final BN feeds the residual add, whose ReLU lives outside).
+      strides: spatial stride (a strided 1x1 conv = slice then matmul).
+
+    Returns:
+      ``(a [B, H', W', N], batch_mean [N], batch_var [N])`` — activations
+      plus the batch statistics for the caller's running-average update
+      (their gradient is stopped; see ``_fused_bwd``).
+
+    Shapes must pass :func:`fused_supported`; callers gate on it.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    if kernel.ndim == 4:
+        kernel = kernel[0, 0]
+    if strides > 1:
+        x4 = x4[:, ::strides, ::strides, :]
+    b, h, w, k = x4.shape
+    n = kernel.shape[1]
+    # H,W,B,C flatten: a bitcast for XLA:TPU's {3,0,2,1} conv layouts at
+    # C >= 128 (docs/PERF.md r3 — B,H,W,C order costs a materialized
+    # relayout copy per boundary).
+    x2 = x4.transpose(1, 2, 0, 3).reshape(h * w * b, k)
+    a2, mean, var = _fused(
+        x2, kernel.astype(x4.dtype), gamma, beta, relu, eps, interpret
+    )
+    a4 = a2.reshape(h, w, b, n).transpose(2, 0, 1, 3)
+    return a4, mean, var

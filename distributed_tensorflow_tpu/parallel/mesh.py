@@ -226,16 +226,24 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+def local_batch_size(
+    global_batch: int, mesh: Mesh, extra_axes: Sequence[str] = ()
+) -> int:
     """Per-host slice of the global batch (for building host-local arrays).
 
     The single rule every loader follows (data/loader.py, data/text.py):
     each of the job's ``jax.process_count()`` hosts materializes an equal
     contiguous slice; ``jax.make_array_from_process_local_data`` assembles
     the global array. Validates divisibility by both the DP world size
-    (shard shapes must be static) and the host count.
+    (shard shapes must be static) and the host count. ``extra_axes`` names
+    additional mesh axes the batch rows shard over (e.g. ``("expert",)``
+    under the token-sharded MoE layout) so the loud divisibility check
+    covers the full row partition, not just the DP axes.
     """
-    n_data = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
+    axes = tuple(data_axes(mesh)) + tuple(
+        a for a in extra_axes if a in mesh.axis_names
+    )
+    n_data = int(np.prod([mesh.shape[a] for a in axes], initial=1))
     if global_batch % n_data:
         raise ValueError(f"global batch {global_batch} not divisible by {n_data}")
     n_proc = jax.process_count()

@@ -56,6 +56,37 @@ def _spec_axes(spec) -> tuple[str, ...]:
     )
 
 
+def _batch_dim_axes(batch_spec) -> set[str]:
+    """Mesh axes the batch's LEADING dim is sharded over, across all leaves.
+
+    Under the GShard token-sharded MoE layout the batch rows split over the
+    ``expert`` axis in addition to the DP axes (data/text.py
+    ``bert_batch_specs(expert_sharded=True)``); the engine must then reduce
+    metrics/model_state over that axis too — it carries data, like DP.
+    """
+    axes: set[str] = set()
+    for s in jax.tree.leaves(
+        batch_spec, is_leaf=lambda x: isinstance(x, P)
+    ):
+        if isinstance(s, P) and len(s) and s[0] is not None:
+            entry = s[0]
+            axes |= set((entry,) if isinstance(entry, str) else tuple(entry))
+    return axes
+
+
+def _extra_batch_axes(batch_spec, dp_axes) -> tuple[str, ...]:
+    """Non-DP mesh axes carrying batch rows (data-like reductions apply).
+
+    Shared by the train and eval steps so their notion of "data-carrying
+    axis" can never diverge.
+    """
+    return tuple(
+        a
+        for a in ("pipeline", "expert", "model")
+        if a in _batch_dim_axes(batch_spec) and a not in dp_axes
+    )
+
+
 def make_train_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
@@ -107,6 +138,13 @@ def make_train_step(
     dp_axes = data_axes(mesh)
     if batch_spec is None:
         batch_spec = batch_pspec(mesh)
+    # Non-DP axes the batch rows are split over (the expert axis under the
+    # token-sharded MoE layout) reduce metrics/model_state like DP axes; the
+    # GRAD contract needs no change — the per-leaf shard-axis loop below
+    # already pmeans replicated leaves over those axes and scales sharded
+    # leaves 1/t.
+    extra_batch_axes = _extra_batch_axes(batch_spec, dp_axes)
+    metric_axes = tuple(dp_axes) + extra_batch_axes
     if state_specs is None:
         state_spec_tree = P()
         param_specs = None
@@ -130,12 +168,17 @@ def make_train_step(
                     f"state.grad_buffer depth {depth} != staleness {staleness}"
                 )
         # Per-device RNG: fold in the global step and the device's coordinate
-        # along every batch-sharding axis (DP axes and, under sequence
-        # parallelism, "seq") so dropout/augmentation is iid per step and per
-        # shard — without the "seq" fold every seq shard would draw the same
-        # dropout mask, making dropout periodic across the global sequence.
+        # along every batch-sharding axis (DP axes, any non-DP row-carrying
+        # axis like "expert" under the token-sharded MoE layout, and "seq"
+        # under sequence parallelism) so dropout/augmentation is iid per
+        # step and per shard — without the fold, shards along that axis
+        # would draw the SAME dropout mask for different data.
         rng = jax.random.fold_in(rng, state.step)
-        rng_axes = list(dp_axes) + (["seq"] if "seq" in mesh.axis_names else [])
+        rng_axes = (
+            list(dp_axes)
+            + list(extra_batch_axes)
+            + (["seq"] if "seq" in mesh.axis_names else [])
+        )
         for ax in rng_axes:
             rng = jax.random.fold_in(rng, lax.axis_index(ax))
 
@@ -189,9 +232,10 @@ def make_train_step(
             # THE sync point: one fused AllReduce over ICI replaces the
             # reference's entire ps round-trip / NCCL ring (SURVEY.md §3b/3d).
             grads = coll.pmean_tree(grads, dp_axes)
-            metrics = coll.pmean_tree(metrics, dp_axes)
+        if metric_axes:
+            metrics = coll.pmean_tree(metrics, metric_axes)
             if model_state:
-                model_state = coll.pmean_tree(model_state, dp_axes)
+                model_state = coll.pmean_tree(model_state, metric_axes)
 
         new_buffer, new_index = state.grad_buffer, state.buffer_index
         if mode == "stale":
@@ -302,6 +346,9 @@ def make_eval_step(
     dp_axes = data_axes(mesh)
     if batch_spec is None:
         batch_spec = batch_pspec(mesh)
+    # Mirror the train step: batch rows split over a non-DP axis (the
+    # expert axis in the token-sharded MoE layout) reduce like DP.
+    red_axes = tuple(dp_axes) + _extra_batch_axes(batch_spec, dp_axes)
     state_spec_tree = P() if state_specs is None else state_specs
 
     def per_device_eval(state: TrainState, batch):
@@ -310,15 +357,15 @@ def make_eval_step(
         for k, v in dict(metrics).items():
             if isinstance(v, tuple):
                 num, den = v
-                if dp_axes:
-                    num = lax.psum(num, dp_axes)
-                    den = lax.psum(den, dp_axes)
+                if red_axes:
+                    num = lax.psum(num, red_axes)
+                    den = lax.psum(den, red_axes)
                 if return_sums:
                     out[k] = (num, den)
                 else:
                     out[k] = num / jnp.maximum(den, 1.0)
             else:
-                val = lax.pmean(v, dp_axes) if dp_axes else v
+                val = lax.pmean(v, red_axes) if red_axes else v
                 out[k] = (val, jnp.float32(1.0)) if return_sums else val
         return out
 

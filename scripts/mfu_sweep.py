@@ -69,6 +69,10 @@ def main():
     ap.add_argument("--batches", type=int, nargs="+", default=[64, 128, 256])
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--model", default="resnet50", choices=["resnet50", "inception"])
+    ap.add_argument("--pw-backend", default="conv",
+                    choices=["conv", "pallas", "fused"],
+                    help="ResNet 1x1-conv path (fused = r4 conv+BN+ReLU "
+                    "backward kernels, ops/fused_conv_bn.py)")
     args = ap.parse_args()
 
     from distributed_tensorflow_tpu.models import ResNet50
@@ -91,6 +95,9 @@ def main():
         hw, flops_per_image = 299, 3 * 5.73e9
     else:
         model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        if args.pw_backend != "conv":
+            import dataclasses
+            model = dataclasses.replace(model, pw_backend=args.pw_backend)
         hw, flops_per_image = 224, FLOPS_PER_IMAGE
     if args.remat:
         import dataclasses

@@ -1,0 +1,190 @@
+"""Fused conv1x1+BN(+ReLU) unit: forward, gradients, and in-model parity.
+
+The invariant: ``pw_backend="fused"`` is NOT a different model — outputs,
+batch statistics, every gradient, and the short training trajectory must
+match the nn.Conv + nn.BatchNorm composition to f32 tolerance (the kernels
+run in interpreter mode on CPU, so these tests exercise the identical code
+path the TPU runs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from distributed_tensorflow_tpu.models.resnet import ResNet, BottleneckBlock
+from distributed_tensorflow_tpu.ops.fused_conv_bn import (
+    conv1x1_bn_act,
+    fused_supported,
+)
+
+B, H, W, K, N = 4, 4, 8, 128, 128  # M = 128 rows, both channel dims >= 128
+
+
+def _ref_unit(x4, kernel, gamma, beta, relu, eps=1e-5):
+    """The exact math nn.Conv + train-mode nn.BatchNorm (+relu) computes."""
+    z = jax.lax.conv_general_dilated(
+        x4, kernel.astype(x4.dtype), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    zf = z.astype(jnp.float32)
+    mean = jnp.mean(zf, axis=(0, 1, 2))
+    var = jnp.mean(jnp.square(zf), axis=(0, 1, 2)) - jnp.square(mean)
+    y = (zf - mean) * (jax.lax.rsqrt(var + eps) * gamma) + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x4.dtype), mean, var
+
+
+def _inputs(key, dtype=jnp.float32):
+    ks = jax.random.split(key, 4)
+    x = jax.random.normal(ks[0], (B, H, W, K), dtype)
+    kernel = jax.random.normal(ks[1], (1, 1, K, N), jnp.float32) * 0.1
+    gamma = 1.0 + 0.1 * jax.random.normal(ks[2], (N,), jnp.float32)
+    beta = 0.1 * jax.random.normal(ks[3], (N,), jnp.float32)
+    return x, kernel, gamma, beta
+
+
+@pytest.mark.parametrize("relu", [True, False])
+def test_fused_forward_matches_reference(relu):
+    x, kernel, gamma, beta = _inputs(jax.random.key(0))
+    a, mean, var = conv1x1_bn_act(x, kernel, gamma, beta, relu=relu)
+    ref, ref_mean, ref_var = _ref_unit(x, kernel, gamma, beta, relu)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(ref_mean), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(ref_var), atol=1e-5)
+
+
+@pytest.mark.parametrize("relu", [True, False])
+def test_fused_gradients_match_reference(relu):
+    """dx, dW, dgamma, dbeta all ride the Pallas kernels — every one must
+    match autodiff through the unfused composition (the BN-backward s1/s2
+    reductions and the ReLU mask are recomputed inside the kernels)."""
+    x, kernel, gamma, beta = _inputs(jax.random.key(1))
+
+    def loss_fused(x, kernel, gamma, beta):
+        a, _, _ = conv1x1_bn_act(x, kernel, gamma, beta, relu=relu)
+        return jnp.sum(jnp.sin(a.astype(jnp.float32) * 0.7))
+
+    def loss_ref(x, kernel, gamma, beta):
+        a, _, _ = _ref_unit(x, kernel, gamma, beta, relu)
+        return jnp.sum(jnp.sin(a.astype(jnp.float32) * 0.7))
+
+    g_f = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, kernel, gamma, beta)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, kernel, gamma, beta)
+    for a, b, name in zip(g_f, g_r, ("dx", "dW", "dgamma", "dbeta")):
+        np.testing.assert_allclose(
+            np.asarray(a).reshape(-1), np.asarray(b).reshape(-1),
+            atol=2e-4, err_msg=name,
+        )
+
+
+def test_fused_strided_matches_reference():
+    """proj position: stride-2 1x1 conv = spatial slice then matmul."""
+    x, kernel, gamma, beta = _inputs(jax.random.key(2))
+    a, mean, var = conv1x1_bn_act(x, kernel, gamma, beta, relu=False, strides=2)
+    ref, ref_mean, _ = _ref_unit(
+        x[:, ::2, ::2, :], kernel, gamma, beta, relu=False
+    )
+    assert a.shape == (B, H // 2, W // 2, N)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(ref_mean), atol=1e-5)
+
+
+def test_fused_supported_gates_c64_and_tiles():
+    assert fused_supported(100352, 512, 128)
+    assert fused_supported(25088, 1024, 256)
+    assert not fused_supported(401408, 256, 64)   # N=64: B-minor layout
+    assert not fused_supported(401408, 64, 256)   # K=64
+    assert not fused_supported(100353, 512, 128)  # M does not tile
+
+
+def _tiny_resnet(pw_backend):
+    # Stage widths >= 128 everywhere so the fused path actually engages
+    # (ResNet-50's stage-1 C=64 shapes are gated off by design).
+    return ResNet(
+        stage_sizes=(1, 1),
+        block=BottleneckBlock,
+        num_filters=32,  # bottleneck widths 128/256 via the 4x expansion
+        num_classes=7,
+        stem="cifar",
+        pw_backend=pw_backend,
+    )
+
+
+def test_fused_resnet_trajectory_matches_conv_backend():
+    """Three SGD steps of a bottleneck ResNet: fused vs plain backend give
+    the same params, batch stats, and losses (param trees are identical by
+    construction, so one init serves both)."""
+    ref_net = _tiny_resnet("conv")
+    fused_net = _tiny_resnet("fused")
+    x0 = jax.random.normal(jax.random.key(0), (8, 8, 8, 3), jnp.float32)
+    variables = ref_net.init(jax.random.key(1), x0, train=False)
+    labels = jax.random.randint(jax.random.key(2), (8,), 0, 7)
+
+    # The fused path engages only for qualified units — make sure the test
+    # geometry actually exercises it (M=512, K/N >= 128 in stage 2).
+    from distributed_tensorflow_tpu.ops.fused_conv_bn import fused_supported as fs
+    assert fs(8 * 8 * 8, 128, 128)
+
+    def run(net):
+        params = variables["params"]
+        stats = variables["batch_stats"]
+        tx = optax.sgd(0.1)
+        opt = tx.init(params)
+        losses = []
+        for i in range(3):
+            def loss_fn(p, st):
+                logits, mods = net.apply(
+                    {"params": p, "batch_stats": st}, x0, train=True,
+                    mutable=["batch_stats"],
+                )
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+                return ce, mods["batch_stats"]
+
+            (l, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, stats
+            )
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+            losses.append(float(l))
+        return params, stats, losses
+
+    p_ref, s_ref, l_ref = run(ref_net)
+    p_fused, s_fused, l_fused = run(fused_net)
+
+    # Identical trees (the fused holders declare the same leaves).
+    assert jax.tree_util.tree_structure(p_ref) == jax.tree_util.tree_structure(p_fused)
+    np.testing.assert_allclose(l_ref, l_fused, atol=1e-4)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(p_ref),
+        jax.tree_util.tree_leaves_with_path(p_fused),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(s_ref),
+        jax.tree_util.tree_leaves_with_path(s_fused),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_fused_eval_mode_uses_running_stats():
+    """train=False falls back to the plain path (running averages) — same
+    predictions from the same variables regardless of backend."""
+    ref_net = _tiny_resnet("conv")
+    fused_net = _tiny_resnet("fused")
+    x0 = jax.random.normal(jax.random.key(3), (4, 8, 8, 3), jnp.float32)
+    variables = ref_net.init(jax.random.key(1), x0, train=False)
+    a = ref_net.apply(variables, x0, train=False)
+    b = fused_net.apply(variables, x0, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
