@@ -21,22 +21,29 @@ bandwidth, docs/PERF.md r3):
 Backward (two Pallas kernels, one logical pass-pair over [M, N]):
 
     g   = dA * mask             mask = (gamma*x_hat+beta > 0) recomputed
-    s1  = sum_m g               \  kernel 1: one streaming read of dA, z
-    s2  = sum_m g * x_hat       /  (x_hat recomputed from z and stats)
+    s1  = sum_m g               \  reduce kernel: streaming read of dA, z;
+    s2  = sum_m g * x_hat       /  per-tile partial rows, summed in XLA
     dz  = gamma*inv * (g - s1/M - x_hat*s2/M)      per-element, in-register
-    dx  = dz @ W^T              \  kernel 2: dz recomputed per tile feeds
-    dW  = x^T @ dz              /  BOTH matmuls — dz is never materialized
-    dgamma = s2, dbeta = s1     (free riders of kernel 1)
+    dx  = dz @ W^T              \  apply kernel: dz recomputed per tile
+    dW  = x^T @ dz              /  feeds BOTH matmuls — never materialized
+    dgamma = s2, dbeta = s1     (free riders of the reduce kernel)
 
 HBM traffic: 4 reads of [M,N] + 1 read/1 write of [M,K] vs the unfused
 XLA chain's ~7 [M,N] passes + the same [M,K] traffic — and unlike the r3
-kernels, zero evicted epilogue work. Layouts follow the r3 measurement:
+kernels, the epilogue work XLA fuses into its dgrads (ReLU mask, BN-bwd
+sums) is absorbed by the kernels. Layouts follow the r3 measurement:
 activations with C >= 128 flatten in H,W,B,C order (a bitcast at the Pallas
 boundary); C = 64 tensors would force relayout copies, so those shapes are
-gated off to the plain path (see :func:`fused_supported`).
+gated off to the plain path (see :func:`fused_supported`). Strided (proj)
+units DO fuse: their python-slice stride lowers to gather/scatter-add
+pairs around the custom-vjp boundary, but gating them off measured WORSE
+in-step (53.5 vs 50.9 ms at b=128) — the proj matmul win exceeds the
+slice tax (docs/PERF.md r4).
 
 The running-stat bookkeeping (flax ``batch_stats`` collection) lives in
-models/resnet.py's ``FusedConvBN`` module; this file is pure function + VJP.
+models/resnet.py's ``_BNParamsStats``/``_Conv1x1Kernel`` holder modules
+(param trees bit-compatible with nn.Conv + nn.BatchNorm); this file is
+pure function + VJP.
 
 Reference parity: replaces the reference's cuDNN conv + fused-BN training
 blocks inside its ResNet-50/Inception workloads (SURVEY.md §2 rows); math is
@@ -116,20 +123,14 @@ def _g_xhat(da_ref, z_ref, c_ref, relu: bool):
     return g, xh
 
 
-def _reduce_kernel(da_ref, z_ref, c_ref, s1_ref, s2_ref, *, relu):
+def _reduce_kernel(da_ref, z_ref, c_ref, s_ref, *, relu):
+    # Partial sums land in this tile's OWN row pair s[i] = [s1_i; s2_i]
+    # (pure streaming, no read-modify-write of a shared accumulator — the
+    # v1 serialized [1, N] output measured ~4x off roofline); the [tiles,
+    # 2, N] partials reduce in XLA, which is tiny.
     g, xh = _g_xhat(da_ref, z_ref, c_ref, relu)
-    p1 = jnp.sum(g, axis=0, keepdims=True)
-    p2 = jnp.sum(g * xh, axis=0, keepdims=True)
-
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        s1_ref[:] = p1
-        s2_ref[:] = p2
-
-    @pl.when(pl.program_id(0) != 0)
-    def _acc():
-        s1_ref[:] = s1_ref[:] + p1
-        s2_ref[:] = s2_ref[:] + p2
+    s_ref[0, 0, :] = jnp.sum(g, axis=0)
+    s_ref[0, 1, :] = jnp.sum(g * xh, axis=0)
 
 
 def _apply_kernel(da_ref, z_ref, x_ref, w_ref, c_ref, dx_ref, dw_ref, *, relu):
@@ -171,25 +172,23 @@ def _pack_consts(mu, inv, gamma, beta, c1=None, c2=None):
 def _bn_bwd_reduce(da2, z2, consts, relu: bool, interpret: bool):
     m, n = da2.shape
     tm = _tile_m(m, 0, n) or m
-    s1, s2 = pl.pallas_call(
+    tiles = m // tm
+    s = pl.pallas_call(
         functools.partial(_reduce_kernel, relu=relu),
-        grid=(m // tm,),
+        grid=(tiles,),
         in_specs=[
             pl.BlockSpec((tm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((tm, n), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((8, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((1, n), jnp.float32),
-            jax.ShapeDtypeStruct((1, n), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec(
+            (1, 2, n), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((tiles, 2, n), jnp.float32),
         interpret=interpret,
     )(da2, z2, consts)
-    return s1[0], s2[0]
+    total = jnp.sum(s, axis=0)
+    return total[0], total[1]
 
 
 def _bn_bwd_apply(da2, z2, x2, w2, consts, relu: bool, interpret: bool):
@@ -257,6 +256,11 @@ def _fused_bwd(relu, eps, interpret, res, cts):
     da2, _, _ = cts
     x2, w2, z2, mean, inv, gamma, beta = res
     m = x2.shape[0]
+    # Reduce-kernel history (all measured in-step, b=128, stages 3-4):
+    # v1 grid-serialized [1, N] accumulator — ~4x off roofline; v2 plain
+    # XLA reductions — WORSE (the pass didn't fuse with da2's producer
+    # across the custom-vjp boundary and re-materialized g); v3 (current)
+    # per-tile partial rows, pure streaming, summed in XLA.
     consts = _pack_consts(mean, inv, gamma, beta)
     s1, s2 = _bn_bwd_reduce(da2, z2, consts, relu, interpret)
     consts = _pack_consts(mean, inv, gamma, beta, s1 / m, s2 / m)

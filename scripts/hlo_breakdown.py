@@ -140,6 +140,18 @@ def parse_hlo(hlo: str):
             if m2:
                 info[m2.group(1)] = {"role": "pool", "layer": "", "flops": 0,
                                      "k": "", "out": None, "bytes": shape_bytes(s)}
+        elif " custom-call(" in s and "tpu_custom_call" in s:
+            # Pallas kernels (the fused conv+BN backward path). op_name
+            # carries the model-path metadata like any other instruction.
+            m2 = DEF_RE.match(s)
+            if m2:
+                nm = re.search(r'op_name="([^"]*)"', s)
+                layer_m = re.search(r"(?:jvp\(ResNet\)\)?/)(.*?)(?:/|\")", nm.group(1)) if nm else None
+                info[m2.group(1)] = {
+                    "role": "pallas", "layer": layer_m.group(1) if layer_m else "",
+                    "flops": 0, "k": "", "out": None,
+                    "bytes": shape_bytes(s),
+                }
     return info
 
 
@@ -169,7 +181,9 @@ def load_trace(trace_dir: str):
     return tot, cnt, max(steps, 1)
 
 
-def build_hlo(batch: int) -> str:
+def build_hlo(batch: int, pw_backend: str = "conv") -> str:
+    import dataclasses
+
     from distributed_tensorflow_tpu.models import ResNet50
     from distributed_tensorflow_tpu.parallel import collectives as coll
     from distributed_tensorflow_tpu.parallel.mesh import build_mesh
@@ -179,6 +193,8 @@ def build_hlo(batch: int) -> str:
 
     mesh = build_mesh({"data": -1})
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    if pw_backend != "conv":
+        model = dataclasses.replace(model, pw_backend=pw_backend)
     params, model_state = init_model(
         model, jax.random.key(0), jnp.zeros((1, 224, 224, 3), jnp.float32))
     tx = optax.sgd(0.1, momentum=0.9)
@@ -197,9 +213,11 @@ def main():
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument("--hlo-out", default=None)
+    ap.add_argument("--pw-backend", default="conv",
+                    choices=["conv", "pallas", "fused"])
     args = ap.parse_args()
 
-    hlo = build_hlo(args.batch)
+    hlo = build_hlo(args.batch, args.pw_backend)
     if args.hlo_out:
         with open(args.hlo_out, "w") as f:
             f.write(hlo)

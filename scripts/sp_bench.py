@@ -140,7 +140,10 @@ def mode_chip(args):
 
     from distributed_tensorflow_tpu.parallel.mesh import build_mesh
 
-    mesh = build_mesh({"data": -1})
+    # A width-1 "seq" axis binds the axis name inside shard_map so the
+    # ring/ulysses code paths trace (their collectives degenerate to
+    # no-ops) — without it lax.axis_size("seq") raises at trace time.
+    mesh = build_mesh({"data": -1, "seq": 1})
     for L in args.lengths:
         b = max(8 * 512 // L, 1) * len(jax.devices())
         for sp in ("none", "ring", "ulysses"):

@@ -102,12 +102,14 @@ def test_fused_supported_gates_c64_and_tiles():
 
 
 def _tiny_resnet(pw_backend):
-    # Stage widths >= 128 everywhere so the fused path actually engages
-    # (ResNet-50's stage-1 C=64 shapes are gated off by design).
+    # num_filters=128: stage-1 Conv_0 is 128->128 (the relu=True fused
+    # unit), Conv_2 is 128->512 (the zero-init-BN relu=False unit), and
+    # stage-2's proj is 512->1024 stride-2 (the strided unit) — all three
+    # fused-unit flavors engage, not just one (C=64 shapes would gate off).
     return ResNet(
         stage_sizes=(1, 1),
         block=BottleneckBlock,
-        num_filters=32,  # bottleneck widths 128/256 via the 4x expansion
+        num_filters=128,
         num_classes=7,
         stem="cifar",
         pw_backend=pw_backend,
@@ -125,9 +127,13 @@ def test_fused_resnet_trajectory_matches_conv_backend():
     labels = jax.random.randint(jax.random.key(2), (8,), 0, 7)
 
     # The fused path engages only for qualified units — make sure the test
-    # geometry actually exercises it (M=512, K/N >= 128 in stage 2).
+    # geometry actually exercises all three flavors: stage-1 Conv_0
+    # (relu=True), stage-1 Conv_2 (relu=False, zero-BN), stage-2 proj
+    # (strided; M drops 4x through the stride-2 slice).
     from distributed_tensorflow_tpu.ops.fused_conv_bn import fused_supported as fs
-    assert fs(8 * 8 * 8, 128, 128)
+    assert fs(8 * 8 * 8, 128, 128)    # Conv_0 128->128
+    assert fs(8 * 8 * 8, 128, 512)    # Conv_2 128->512
+    assert fs(8 * 4 * 4, 512, 1024)   # proj 512->1024 post-stride
 
     def run(net):
         params = variables["params"]
