@@ -136,52 +136,6 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual)
 
 
-class _Conv1x1Kernel(nn.Module):
-    """Kernel-param holder for the fused conv+BN path — declares exactly the
-    ``kernel`` leaf ``nn.Conv(features, (1,1), use_bias=False)`` would, so
-    the param tree (and any checkpoint) is identical across backends."""
-
-    cin: int
-    features: int
-
-    @nn.compact
-    def __call__(self):
-        return self.param(
-            "kernel",
-            nn.initializers.he_normal(),
-            (1, 1, self.cin, self.features),
-            jnp.float32,
-        )
-
-
-class _BNParamsStats(nn.Module):
-    """BatchNorm param/stat holder matching ``nn.BatchNorm``'s tree exactly
-    (params ``scale``/``bias``; ``batch_stats`` collection ``mean``/``var``).
-    First call (no args) reads; second call folds the fused op's batch stats
-    into the running averages with flax's momentum rule."""
-
-    features: int
-    momentum: float = 0.9
-    scale_init: Callable = nn.initializers.ones_init()
-
-    @nn.compact
-    def __call__(self, batch_mean=None, batch_var=None):
-        f = self.features
-        scale = self.param("scale", self.scale_init, (f,), jnp.float32)
-        bias = self.param("bias", nn.initializers.zeros_init(), (f,), jnp.float32)
-        ra_mean = self.variable(
-            "batch_stats", "mean", lambda: jnp.zeros((f,), jnp.float32)
-        )
-        ra_var = self.variable(
-            "batch_stats", "var", lambda: jnp.ones((f,), jnp.float32)
-        )
-        if batch_mean is not None and not self.is_initializing():
-            m = self.momentum
-            ra_mean.value = m * ra_mean.value + (1 - m) * batch_mean
-            ra_var.value = m * ra_var.value + (1 - m) * batch_var
-        return scale, bias
-
-
 class BottleneckBlock(nn.Module):
     """1x1 down / 3x3 / 1x1 up (x4) bottleneck block (ImageNet ResNets).
 
@@ -213,8 +167,8 @@ class BottleneckBlock(nn.Module):
     def _unit(self, x, features, strides, conv_name, bn_name, relu, zero_bn):
         """One conv1x1 -> BN (-> ReLU) unit; fused when shapes qualify."""
         from distributed_tensorflow_tpu.ops.fused_conv_bn import (
-            conv1x1_bn_act,
             fused_supported,
+            fused_unit,
         )
 
         b, h, w, cin = x.shape
@@ -229,19 +183,16 @@ class BottleneckBlock(nn.Module):
         # measured WORSE in-step (53.5 vs 50.9 ms b=128) — the fused
         # backward win on the proj matmuls exceeds the slice tax.
         if self.fused and self.train and fused_supported(m, cin, features):
-            kernel = _Conv1x1Kernel(cin, features, name=conv_name)()
-            bn = _BNParamsStats(features, scale_init=scale_init, name=bn_name)
-            scale, bias = bn()
-            a, bm, bv = conv1x1_bn_act(
-                x.astype(self.dtype),
-                kernel,
-                scale,
-                bias,
+            return fused_unit(
+                x,
+                features,
                 relu=relu,
+                conv_name=conv_name,
+                bn_name=bn_name,
+                dtype=self.dtype,
                 strides=strides,
+                scale_init=scale_init,
             )
-            bn(bm, bv)  # running-average update (flax momentum rule)
-            return a
         y = self._c1(features, strides=strides, name=conv_name)(x)
         kw = {"scale_init": scale_init} if zero_bn else {}
         y = self.norm(name=bn_name, **kw)(y)

@@ -316,3 +316,98 @@ def conv1x1_bn_act(
     )
     a4 = a2.reshape(h, w, b, n).transpose(2, 0, 1, 3)
     return a4, mean, var
+
+
+# ---------------------------------------------------------------------------
+# Flax-side plumbing shared by every model that hosts a fused unit
+# (models/resnet.py BottleneckBlock, models/inception.py BasicConv). The
+# holder modules declare EXACTLY the leaves nn.Conv(use_bias=False) and
+# nn.BatchNorm would, under the same child names, so param trees and
+# checkpoints interchange across backends.
+# ---------------------------------------------------------------------------
+
+
+from collections.abc import Callable
+
+from flax import linen as nn
+
+
+class Conv1x1Kernel(nn.Module):
+    """Kernel-param holder — declares exactly the ``kernel`` leaf
+    ``nn.Conv(features, (1,1), use_bias=False)`` would."""
+
+    cin: int
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "kernel",
+            nn.initializers.he_normal(),
+            (1, 1, self.cin, self.features),
+            jnp.float32,
+        )
+
+
+class BNParamsStats(nn.Module):
+    """BatchNorm param/stat holder matching ``nn.BatchNorm``'s tree. First
+    call (no args) reads scale/bias; second call folds the fused op's batch
+    stats into the running averages (flax momentum rule)."""
+
+    features: int
+    momentum: float = 0.9
+    scale_init: Callable = nn.initializers.ones_init()
+
+    @nn.compact
+    def __call__(self, batch_mean=None, batch_var=None):
+        f = self.features
+        scale = self.param("scale", self.scale_init, (f,), jnp.float32)
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (f,), jnp.float32
+        )
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((f,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((f,), jnp.float32)
+        )
+        if batch_mean is not None and not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * batch_mean
+            ra_var.value = m * ra_var.value + (1 - m) * batch_var
+        return scale, bias
+
+
+def fused_unit(
+    x,
+    features: int,
+    *,
+    relu: bool,
+    conv_name: str,
+    bn_name: str,
+    dtype,
+    strides: int = 1,
+    eps: float = 1e-5,
+    scale_init=None,
+):
+    """One conv1x1+BN(+ReLU) fused unit, declared under the CALLER's scope.
+
+    Must be called from inside a flax ``@nn.compact`` ``__call__`` — the
+    holder modules (kernel param under ``conv_name``, BN params/stats under
+    ``bn_name``) attach to the calling module. Shared by ResNet's
+    BottleneckBlock and Inception's BasicConv so fused-unit fixes land
+    once.
+    """
+    kernel = Conv1x1Kernel(x.shape[-1], features, name=conv_name)()
+    bn = BNParamsStats(
+        features,
+        scale_init=scale_init or nn.initializers.ones_init(),
+        name=bn_name,
+    )
+    scale, bias = bn()
+    a, bm, bv = conv1x1_bn_act(
+        x.astype(dtype), kernel, scale, bias,
+        relu=relu, strides=strides, eps=eps,
+    )
+    bn(bm, bv)  # flax momentum-rule running-average update
+    return a

@@ -90,8 +90,18 @@ def main():
     if args.model == "inception":
         from distributed_tensorflow_tpu.models import InceptionV3
 
+        if args.pw_backend == "pallas":
+            raise SystemExit(
+                "--model inception supports --pw-backend conv|fused only "
+                "(the r3 'pallas' 1x1 path is ResNet-specific)"
+            )
         # Inception-v3 at 299x299: ~5.73 GFLOP/image fwd (standard count).
-        model = InceptionV3(num_classes=1000, dtype=jnp.bfloat16, aux_logits=False)
+        model = InceptionV3(
+            num_classes=1000,
+            dtype=jnp.bfloat16,
+            aux_logits=False,
+            fused=args.pw_backend == "fused",
+        )
         hw, flops_per_image = 299, 3 * 5.73e9
     else:
         model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)

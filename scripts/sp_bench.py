@@ -31,7 +31,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_step(mesh, sp_impl: str, L: int, seq: int, batch: int):
+def _build_step(mesh, sp_impl: str, L: int, seq: int, batch: int,
+                device_params=None):
     import dataclasses
 
     import jax
@@ -53,23 +54,30 @@ def _build_step(mesh, sp_impl: str, L: int, seq: int, batch: int):
     from distributed_tensorflow_tpu.train.step import place_state
 
     cfg = BertConfig(max_position=L, dropout_rate=0.0, dtype=jnp.bfloat16)
-    init_model = BertForPreTraining(cfg)
     model_cfg = cfg
     seq_sharded = seq > 1
     if sp_impl != "none":
         model_cfg = dataclasses.replace(cfg, seq_axis="seq", sp_impl=sp_impl)
     model = BertForPreTraining(model_cfg)
-    variables = init_model.init(
-        jax.random.key(0),
-        jnp.zeros((1, L), jnp.int32),
-        jnp.ones((1, L), bool),
-        jnp.zeros((1, L), jnp.int32),
-        train=False,
-    )
     tx = optax.adamw(1e-4, weight_decay=0.01)
-    state = place_state(
-        create_train_state(jax.device_get(variables["params"]), tx), mesh
-    )
+    if device_params is None:
+        variables = BertForPreTraining(cfg).init(
+            jax.random.key(0),
+            jnp.zeros((1, L), jnp.int32),
+            jnp.ones((1, L), bool),
+            jnp.zeros((1, L), jnp.int32),
+            train=False,
+        )
+        device_params = jax.device_get(variables["params"])
+        state = place_state(create_train_state(device_params, tx), mesh)
+    else:
+        # On-device copy: the step donates the state, so each caller gets a
+        # fresh copy WITHOUT re-pushing ~1.3 GB through the host tunnel
+        # (measured ~235 s per push on this platform).
+        state = place_state(
+            create_train_state(jax.tree.map(jnp.copy, device_params), tx),
+            mesh,
+        )
     step = make_train_step(
         make_bert_pretraining_loss(model),
         tx,
@@ -143,11 +151,20 @@ def mode_chip(args):
     # A width-1 "seq" axis binds the axis name inside shard_map so the
     # ring/ulysses code paths trace (their collectives degenerate to
     # no-ops) — without it lax.axis_size("seq") raises at trace time.
+    import jax.numpy as jnp
+
     mesh = build_mesh({"data": -1, "seq": 1})
     for L in args.lengths:
         b = max(8 * 512 // L, 1) * len(jax.devices())
+        params_dev = None
         for sp in ("none", "ring", "ulysses"):
-            step, state, batch = _build_step(mesh, sp, L, seq=1, batch=b)
+            step, state, batch = _build_step(
+                mesh, sp, L, seq=1, batch=b, device_params=params_dev
+            )
+            if params_dev is None:
+                # Protect a device copy from the step's donation so later
+                # strategies skip the ~235 s host->device state push.
+                params_dev = jax.tree.map(jnp.copy, state.params)
             state, metrics = step(state, batch, jax.random.key(1))
             float(metrics["loss"])  # warm + barrier
             n = 30

@@ -36,6 +36,25 @@ def test_cli_mnist_end_to_end(tmp_path):
     assert "loss" in lines[-1]
 
 
+def test_cli_device_pool_trains(tmp_path):
+    """--device-pool N: batches stay resident and cycle; the run trains at
+    device rate with the host feed out of the hot loop."""
+    rc = main(
+        [
+            "--config=mnist_lenet",
+            "--steps=6",
+            "--global-batch=32",
+            "--device-pool=2",
+            "--log-every=3",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert lines and lines[-1]["step"] == 6
+    assert lines[-1]["loss"] == lines[-1]["loss"]  # finite
+
+
 def test_cli_resume_from_checkpoint(tmp_path):
     args = [
         "--config=mnist_lenet",

@@ -184,6 +184,54 @@ def test_fused_resnet_trajectory_matches_conv_backend():
         )
 
 
+def test_fused_basicconv_matches_plain_inception_unit():
+    """Inception's BasicConv with fused=True: same outputs, stats, and
+    gradients as the plain conv+BN+relu path (eps=1e-3 — Inception's BN)."""
+    from distributed_tensorflow_tpu.models.inception import BasicConv
+
+    x = jax.random.normal(jax.random.key(0), (4, 4, 8, 128), jnp.float32)
+    ref_net = BasicConv(128, (1, 1))
+    fused_net = BasicConv(128, (1, 1), fused=True)
+    variables = ref_net.init(jax.random.key(1), x, train=False)
+    assert jax.tree_util.tree_structure(
+        variables
+    ) == jax.tree_util.tree_structure(fused_net.init(jax.random.key(1), x, train=False))
+
+    def run(net, p, st):
+        out, mods = net.apply(
+            {"params": p, "batch_stats": st}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        return out, mods["batch_stats"]
+
+    def loss(net, p, st):
+        out, _ = run(net, p, st)
+        return jnp.sum(jnp.sin(out * 0.3))
+
+    p, st = variables["params"], variables["batch_stats"]
+    o_ref, st_ref = run(ref_net, p, st)
+    o_f, st_f = run(fused_net, p, st)
+    np.testing.assert_allclose(np.asarray(o_ref), np.asarray(o_f), atol=1e-5)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(st_ref),
+        jax.tree_util.tree_leaves_with_path(st_f),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+    g_ref = jax.grad(lambda p: loss(ref_net, p, st))(p)
+    g_f = jax.grad(lambda p: loss(fused_net, p, st))(p)
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g_ref),
+        jax.tree_util.tree_leaves_with_path(g_f),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def test_fused_eval_mode_uses_running_stats():
     """train=False falls back to the plain path (running averages) — same
     predictions from the same variables regardless of backend."""
