@@ -65,7 +65,9 @@ class WorkloadConfig:
     tensor_parallel: int = 0  # >0: model axis size for Megatron-TP (BERT)
     moe_experts: int = 0  # >0: switch-MoE FFN with this many experts (BERT)
     expert_parallel: int = 0  # >0: expert axis size for MoE sharding (BERT)
-    moe_dispatch: str = "replicated"  # "replicated" | "alltoall" (GShard a2a)
+    # "replicated" | "alltoall" (GShard a2a over replicated tokens) |
+    # "sharded" (production GShard: batch sharded over the expert axis)
+    moe_dispatch: str = "replicated"
     pipeline_parallel: int = 0  # >0: pipeline axis size, stage-sharded encoder (BERT)
     pipeline_microbatches: int = 0  # GPipe M; 0 -> 4 * pipeline_parallel
     bert_layers: int = 0  # >0: override encoder depth (smoke runs)
@@ -663,14 +665,20 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         state, start = ckpt.restore_latest(state)
     # Resume-correct stream: batches start at N, not 0 (the fix for the
     # reference-era replay-on-restart).
-    batches = pieces["batches"](start)
+    batches = pieces["batches"](0 if cfg.device_pool > 0 else start)
     if cfg.device_pool > 0:
         # Device-resident pool: materialize the first N batches in HBM once
         # and cycle — the host (and on this platform, the tunnel) leaves the
         # hot loop entirely. Safe to reuse batches across steps: the train
-        # step donates only the state, never the batch.
+        # step donates only the state, never the batch. Resume-correctness
+        # for pool mode means something different than for streams: the
+        # pool is ALWAYS stream positions 0..N-1 and a resumed run re-enters
+        # the cycle at step % N, exactly reproducing the uninterrupted
+        # trajectory (building the pool from position `start` instead would
+        # silently train on different data after every restart).
         src = batches
         pool = [next(src) for _ in range(cfg.device_pool)]
+        pool = pool[start % cfg.device_pool:] + pool[: start % cfg.device_pool]
         jax.block_until_ready(pool[-1])
         close_src = getattr(src, "close", None)
         if close_src is not None:

@@ -54,7 +54,7 @@ def _fit_block(default: int, l: int) -> int:
     [l, l] f32 score tile in VMEM) instead of surfacing the geometry error.
     """
     b = min(default, l)
-    if b >= 8 and l % b == 0:
+    if b >= 8 and b % 8 == 0 and l % b == 0:
         return b
     b -= b % 8
     while b >= 8 and l % b:

@@ -353,6 +353,47 @@ def test_moe_sharded_batch_specs_cover_expert_axis(devices8):
 
 
 @pytest.mark.slow
+def test_moe_sharded_with_seq_parallel_trains(devices8):
+    """Token-sharded dispatch composes with the seq ring: batch rows over
+    data x expert, sequence over seq — all three token-sharding families in
+    one compiled step."""
+    cfg = BertConfig(
+        **TINY_MOE,
+        seq_axis="seq",
+        expert_axis="expert",
+        expert_parallel=2,
+        moe_dispatch="sharded",
+    )
+    init_cfg = BertConfig(**TINY_MOE)
+    params = _init_global(init_cfg)
+    mesh = build_mesh({"data": 2, "seq": 2, "expert": 2})
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis=None, expert_axis="expert"),
+    )
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+    batches = mlm_device_batches(
+        data, mesh, 8, seq_sharded=True, expert_sharded=True, seed=0
+    )
+    state = place_state(create_train_state(params, tx), mesh, specs)
+    step = make_train_step(
+        make_bert_pretraining_loss(BertForPreTraining(cfg)),
+        tx,
+        mesh,
+        batch_spec=bert_batch_specs(mesh, seq_sharded=True, expert_sharded=True),
+        state_specs=specs,
+    )
+    metrics = None
+    for _ in range(2):
+        state, metrics = step(state, next(batches), jax.random.key(1))
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["moe_aux"]) > 0
+    assert int(state.step) == 2
+
+
+@pytest.mark.slow
 def test_moe_with_seq_parallel_trains(devices8):
     """MoE x SP unlocked: data x seq x expert mesh, a2a dispatch, global
     aux-loss statistics over both token-sharding axes."""
