@@ -678,8 +678,11 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         # silently train on different data after every restart).
         src = batches
         pool = [next(src) for _ in range(cfg.device_pool)]
+        # Block on the WHOLE pool before rotating: after rotation pool[-1]
+        # is no longer the last-enqueued transfer, so a single-leaf wait
+        # would let later transfers bleed into the first timed step.
+        jax.block_until_ready(pool)
         pool = pool[start % cfg.device_pool:] + pool[: start % cfg.device_pool]
-        jax.block_until_ready(pool[-1])
         close_src = getattr(src, "close", None)
         if close_src is not None:
             close_src()
