@@ -414,14 +414,18 @@ class BertModel(nn.Module):
         cfg = self.cfg
         self.embeddings = BertEmbeddings(cfg)
         if cfg.pipeline_axis is not None or cfg.pipeline_parallel > 1:
-            if cfg.seq_axis is not None or cfg.moe_experts:
-                # pp x tp IS supported (stage-sharded stack whose layers are
-                # additionally Megatron-sharded — bert_param_specs composes
-                # the specs, the engine's per-leaf contract divides by both
-                # axis factors; tests/test_bert_pp.py pins the trajectory).
+            if cfg.seq_axis is not None:
+                # pp x tp and pp x moe ARE supported (stage-sharded stack
+                # whose layers are additionally Megatron- and/or expert-
+                # sharded — bert_param_specs composes the specs, the
+                # engine's per-leaf contract divides by each axis factor,
+                # and the GPipe schedule threads the MoE aux loss out with
+                # drain-phase masking; tests/test_bert_pp.py pins the
+                # trajectories). Sequence parallelism inside the pipeline
+                # (seq-sharded microbatches) remains future work.
                 raise NotImplementedError(
-                    "pipeline parallelism composes with dp and tp only for "
-                    "now; unset seq_axis/moe_experts"
+                    "pipeline parallelism does not compose with seq_axis "
+                    "yet; unset one of them"
                 )
             if cfg.num_layers % cfg.pipeline_parallel:
                 raise ValueError(
@@ -430,7 +434,11 @@ class BertModel(nn.Module):
                 )
             self.encoder = nn.scan(
                 _ScanBertLayer,
-                variable_axes={"params": 0},
+                # intermediates rides the scan too (stacked per layer):
+                # the MoE FFN sows its aux loss there, and the sequential-
+                # semantics path (init / single-stage runs) must carry it
+                # exactly like the per-layer module list does.
+                variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 in_axes=(nn.broadcast, nn.broadcast),
@@ -467,6 +475,7 @@ class BertModel(nn.Module):
         # parent=None: a detached functional instance — its .apply below runs
         # on explicit param slices, never registering as a submodule here.
         layer = BertLayer(cfg, parent=None)
+        moe = cfg.moe_experts > 0
 
         def layer_fn(p_one, h, ctx):
             m = lax.dynamic_index_in_dim(
@@ -476,16 +485,34 @@ class BertModel(nn.Module):
             if need_rng:
                 r = jax.random.fold_in(base_rng, ctx["layer"])
                 rngs = {"dropout": jax.random.fold_in(r, ctx["microbatch"])}
+            if moe:
+                # The detached apply would drop sown intermediates — pull
+                # the MoE aux out explicitly and let the schedule thread it
+                # (pipeline_apply with_aux masks drain-phase garbage).
+                h2, mods = layer.apply(
+                    {"params": p_one}, h, m, train=train, rngs=rngs,
+                    mutable=["intermediates"],
+                )
+                leaves = jax.tree.leaves(mods["intermediates"])
+                return h2, sum(leaves) / len(leaves)
             return layer.apply({"params": p_one}, h, m, train=train, rngs=rngs)
 
-        return pipeline_apply(
+        out = pipeline_apply(
             layer_fn,
             stacked,
             x,
             axis_name=cfg.pipeline_axis,
             n_microbatches=M,
             with_context=True,
+            with_aux=moe,
         )
+        if moe:
+            x, aux = out
+            # Re-sow under this module so make_bert_pretraining_loss's
+            # intermediates average finds it, same as the sequential path.
+            self.sow("intermediates", "moe_aux", aux)
+            return x
+        return out
 
     def __call__(self, input_ids, attention_mask, token_type_ids, *, train=False):
         cfg = self.cfg
@@ -707,8 +734,11 @@ def make_bert_pretraining_loss(model: BertForPreTraining):
             mutable=["intermediates"],
         )
         if moe:
+            # Leaves are scalars (per-layer module list; the pipelined
+            # encoder's pre-averaged sow) or stacked [num_layers] arrays
+            # (the nn.scan encoder) — jnp.mean handles both uniformly.
             aux_leaves = jax.tree.leaves(mods["intermediates"])
-            moe_aux = sum(aux_leaves) / len(aux_leaves)
+            moe_aux = sum(jnp.mean(a) for a in aux_leaves) / len(aux_leaves)
         num, den, correct = _mlm_stats(mlm_logits, batch, seq_axis)
         den = jnp.maximum(den, 1.0)
         mlm_loss = num / den

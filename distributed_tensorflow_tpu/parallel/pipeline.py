@@ -50,6 +50,7 @@ def pipeline_apply(
     axis_name: str = "pipeline",
     n_microbatches: int,
     with_context: bool = False,
+    with_aux: bool = False,
 ):
     """Run a stage-sharded layer stack over ``x`` with GPipe microbatching.
 
@@ -65,9 +66,19 @@ def pipeline_apply(
       axis_name: bound pipeline mesh axis.
       n_microbatches: GPipe M; higher M = smaller bubble, smaller per-tick
         matmuls.
+      with_aux: layer_fn additionally returns a scalar auxiliary loss per
+        (layer, microbatch) call — e.g. a MoE load-balance term. Drain- and
+        fill-phase ticks compute garbage microbatches whose aux is MASKED
+        OUT; valid contributions are summed across ticks and psum'd across
+        stages, and the MEAN over the ``n_layers * M`` real calls is
+        returned. (Each call's aux is a per-microbatch-group statistic —
+        the grouped analog of the sequential encoder's per-layer full-batch
+        aux; with capacity to spare and i.i.d. microbatches the two agree,
+        and tests pin exact equality on tiled batches.)
 
     Returns:
-      ``[B, ...]`` — the stack's output, identical on every stage.
+      ``[B, ...]`` (with ``with_aux``: a ``(y, aux_mean)`` tuple) — the
+      stack's output, identical on every stage.
     """
     S = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -82,17 +93,28 @@ def pipeline_apply(
     n_local = jax.tree.leaves(stacked_params)[0].shape[0]
 
     def run_stage(h, mb_idx):
-        def body(h, xs):
+        def body(carry, xs):
+            h, aux_acc = carry
             p_one, local_idx = xs
+            args = (p_one, h)
             if with_context:
                 ctx = {"layer": stage * n_local + local_idx, "microbatch": mb_idx}
-                return layer_fn(p_one, h, ctx), None
-            return layer_fn(p_one, h), None
+                args = (p_one, h, ctx)
+            out = layer_fn(*args)
+            if with_aux:
+                h, aux = out
+                aux_acc = aux_acc + aux
+            else:
+                h = out
+            return (h, aux_acc), None
 
-        h, _ = lax.scan(body, h, (stacked_params, jnp.arange(n_local)))
-        return h
+        (h, aux_sum), _ = lax.scan(
+            body, (h, jnp.float32(0.0)), (stacked_params, jnp.arange(n_local))
+        )
+        return h, aux_sum
 
-    def tick(buf, t):
+    def tick(carry, t):
+        buf, aux_acc = carry
         # Stage 0 ingests microbatch t (clamped in the drain phase — those
         # ticks compute garbage that is never collected); later stages take
         # the neighbor's value that arrived on the previous tick. Stage s
@@ -102,12 +124,18 @@ def pipeline_apply(
         )
         h_in = jnp.where(stage == 0, inject, buf)
         mb_idx = jnp.clip(t - stage, 0, M - 1)
-        h_out = run_stage(h_in, mb_idx)
+        h_out, aux_tick = run_stage(h_in, mb_idx)
+        # Fill/drain ticks process clamped garbage — their aux must not
+        # pollute the loss. Valid iff this stage holds a REAL microbatch.
+        valid = ((t - stage) >= 0) & ((t - stage) < M)
+        aux_acc = aux_acc + jnp.where(valid, aux_tick, 0.0)
         buf_next = lax.ppermute(h_out, axis_name, fwd_perm)
-        return buf_next, h_out
+        return (buf_next, aux_acc), h_out
 
     buf0 = jnp.zeros_like(mb[0])
-    _, outs = lax.scan(tick, buf0, jnp.arange(T))
+    (_, aux_acc), outs = lax.scan(
+        tick, (buf0, jnp.float32(0.0)), jnp.arange(T)
+    )
     # The last stage emits microbatch j at tick j + (S-1). Collect its M
     # valid outputs and broadcast them to every stage.
     outs_last = lax.dynamic_slice_in_dim(outs, S - 1, M, 0)
@@ -115,7 +143,14 @@ def pipeline_apply(
         jnp.where(stage == S - 1, outs_last, jnp.zeros_like(outs_last)),
         axis_name,
     )
-    return y.reshape(B, *x.shape[1:])
+    y = y.reshape(B, *x.shape[1:])
+    if with_aux:
+        # Sum over stages = sum over all n_layers * M real (layer, mb)
+        # calls; normalize to the mean like the sequential encoder's
+        # per-layer average.
+        aux_mean = lax.psum(aux_acc, axis_name) / (n_local * S * M)
+        return y, aux_mean
+    return y
 
 
 def stack_layer_params(per_layer_params: list) -> Any:

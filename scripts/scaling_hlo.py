@@ -77,6 +77,15 @@ def run_one(n_devices: int) -> None:
         f"per-device collectives: {detail}",
         flush=True,
     )
+    # Machine-readable line for the parent's EXACT comparison — formatted
+    # output would hide sub-0.01-unit drift.
+    import json
+
+    print(
+        "RAW " + json.dumps({"flops": cost.get("flops"), "bytes": bts},
+                            sort_keys=True),
+        flush=True,
+    )
 
 
 def main():
@@ -95,26 +104,34 @@ def main():
             text=True,
             timeout=1200,
         )
-        out = [
+        human = [
             line
             for line in proc.stdout.splitlines()
             if line.startswith("devices=")
         ]
-        if proc.returncode != 0 or not out:
+        raw = [
+            line
+            for line in proc.stdout.splitlines()
+            if line.startswith("RAW ")
+        ]
+        if proc.returncode != 0 or not human or not raw:
             raise SystemExit(
                 f"n={n} failed rc={proc.returncode}\n{proc.stderr[-2000:]}"
             )
-        print(out[-1], flush=True)
-        results.append(out[-1].split("GFLOP/step")[1])
+        print(human[-1], flush=True)
+        results.append(raw[-1])
     if len(set(results)) == 1:
         print(
-            "PASS: per-device FLOPs and collective bytes are IDENTICAL at "
-            "8/32/128 devices — the compiled step is scale-invariant; the "
-            "only scale-dependent cost is the AllReduce ring itself.",
+            "PASS: raw per-device FLOPs and collective bytes are EXACTLY "
+            "identical at 8/32/128 devices — the compiled step is "
+            "scale-invariant; the only scale-dependent cost is the "
+            "AllReduce ring itself.",
             flush=True,
         )
     else:
-        print("FAIL: per-device cost drifts with mesh size", flush=True)
+        print("FAIL: per-device cost drifts with mesh size:", flush=True)
+        for r in results:
+            print("  " + r, flush=True)
         raise SystemExit(1)
 
 
