@@ -414,19 +414,15 @@ class BertModel(nn.Module):
         cfg = self.cfg
         self.embeddings = BertEmbeddings(cfg)
         if cfg.pipeline_axis is not None or cfg.pipeline_parallel > 1:
-            if cfg.seq_axis is not None:
-                # pp x tp and pp x moe ARE supported (stage-sharded stack
-                # whose layers are additionally Megatron- and/or expert-
-                # sharded — bert_param_specs composes the specs, the
-                # engine's per-leaf contract divides by each axis factor,
-                # and the GPipe schedule threads the MoE aux loss out with
-                # drain-phase masking; tests/test_bert_pp.py pins the
-                # trajectories). Sequence parallelism inside the pipeline
-                # (seq-sharded microbatches) remains future work.
-                raise NotImplementedError(
-                    "pipeline parallelism does not compose with seq_axis "
-                    "yet; unset one of them"
-                )
+            # Every parallelism family composes with the pipeline: tp
+            # (Megatron-sharded stacked layers), moe/ep (aux threaded
+            # through the GPipe schedule with drain masking), and sp (the
+            # microbatch split is over batch ROWS while the seq axis
+            # shards length — orthogonal dims, so the ring/Ulysses
+            # collectives simply run per (layer, microbatch) inside the
+            # schedule; the attention-mask microbatching slices the
+            # seq-LOCAL mask). Trajectories pinned in
+            # tests/test_bert_pp.py.
             if cfg.num_layers % cfg.pipeline_parallel:
                 raise ValueError(
                     f"num_layers {cfg.num_layers} not divisible by "

@@ -404,18 +404,57 @@ def test_pp_ep_composition_trains(devices8):
     assert int(state.step) == 2
 
 
-def test_pp_rejects_sp_composition():
-    cfg = BertConfig(
-        **TINY, pipeline_axis="pipeline", pipeline_parallel=2, seq_axis="seq"
+@pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+def test_pp_sp_training_matches_sequential(devices8, sp_impl):
+    """pp x sp — the final composition: microbatches split batch ROWS while
+    the seq axis shards LENGTH (orthogonal dims), so ring/Ulysses attention
+    runs per (layer, microbatch) inside the GPipe schedule. The trajectory
+    must match the sequential unsharded-sequence encoder exactly (both SP
+    strategies are exact full attention)."""
+    seq_cfg = BertConfig(**TINY, pipeline_parallel=2)
+    params = _init_seq(seq_cfg)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+
+    mesh_ref = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    b_ref = mlm_device_batches(data, mesh_ref, 16, seed=3)
+    state_ref, m_ref = _run(mesh_ref, seq_cfg, params, b_ref, 3)
+
+    cfg = dataclasses.replace(
+        seq_cfg,
+        pipeline_axis="pipeline",
+        pipeline_microbatches=4,
+        seq_axis="seq",
+        sp_impl=sp_impl,
     )
-    # match pins the INTENDED loud rejection (flax may wrap the
-    # NotImplementedError, but the message survives) — a future unrelated
-    # init failure must not silently satisfy this test.
-    with pytest.raises(Exception, match="seq_axis"):
-        BertForPreTraining(cfg).init(
-            jax.random.key(0),
-            jnp.zeros((1, L), jnp.int32),
-            jnp.ones((1, L), bool),
-            jnp.zeros((1, L), jnp.int32),
-            train=False,
+    mesh = build_mesh({"data": 2, "pipeline": 2, "seq": 2})
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis=None, pipeline_axis="pipeline"),
+    )
+    b_sp = mlm_device_batches(data, mesh, 16, seq_sharded=True, seed=3)
+    state_sp, m_sp = _run(
+        mesh,
+        cfg,
+        params,
+        b_sp,
+        3,
+        state_specs=specs,
+        batch_spec=bert_batch_specs(mesh, seq_sharded=True),
+    )
+
+    assert np.isclose(float(m_ref["loss"]), float(m_sp["loss"]), atol=1e-4), (
+        float(m_ref["loss"]),
+        float(m_sp["loss"]),
+    )
+    assert np.isclose(
+        float(m_ref["grad_norm"]), float(m_sp["grad_norm"]), rtol=1e-4
+    ), (float(m_ref["grad_norm"]), float(m_sp["grad_norm"]))
+    flat_ref = jax.tree_util.tree_leaves_with_path(jax.device_get(state_ref.params))
+    flat_sp = dict(jax.tree_util.tree_leaves_with_path(jax.device_get(state_sp.params)))
+    for path, leaf in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_sp[path]), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
         )
