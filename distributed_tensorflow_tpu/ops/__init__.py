@@ -11,6 +11,11 @@ from distributed_tensorflow_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     flash_attention_block,
 )
+from distributed_tensorflow_tpu.ops.fused_conv_bn import (  # noqa: F401
+    conv1x1_bn_act,
+    fused_supported,
+    fused_unit,
+)
 from distributed_tensorflow_tpu.ops.pointwise_conv import (  # noqa: F401
     pointwise_conv,
     pointwise_matmul,
