@@ -137,8 +137,8 @@ def main():
     # the transformer context too — VERDICT r3 Weak #5).
     ceil_note = (
         "meas-roofline-ceiling~0.30, practical-max~0.17 per docs/PERF.md r4 "
-        "kernel study; transformer context: bert-base L=512 mfu=0.331 "
-        "flash (scripts/bench_bert.py r3)"
+        "kernel study; transformer context: bert-base L=512 mfu=0.360 "
+        "flash b=48 (scripts/bench_bert.py r4 sweep)"
         if on_tpu
         else "cpu-smoke"
     )

@@ -126,7 +126,8 @@ def bench_config(
 def main():
     results = [
         bench_config(128, 128, attn_impl="auto"),  # auto -> dense at 128
-        bench_config(512, 24, attn_impl="auto"),   # auto -> flash at 512
+        bench_config(512, 48, attn_impl="auto"),   # auto -> flash at 512;
+        # b=48 won the r4 sweep (same config driver_line reports)
     ]
     for r in results:
         print(json.dumps(r))
@@ -135,7 +136,9 @@ def main():
 
 def driver_line():
     """One-line JSON for the driver protocol (bench.py BENCH_WORKLOAD=bert)."""
-    r = bench_config(512, 24, attn_impl="auto")  # auto -> flash at L=512
+    # b=48/chip won the r4 L=512 batch sweep (mfu 0.331 @ 24, 0.360 @ 48,
+    # 0.353 @ 64, 0.324 @ 96 — docs/PERF.md r4).
+    r = bench_config(512, 48, attn_impl="auto")  # auto -> flash at L=512
     dev = jax.devices()[0]
     print(
         json.dumps(
