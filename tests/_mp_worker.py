@@ -4,10 +4,15 @@ The SPMD analog of the reference's "launch real ps/workers on localhost
 ports" testing idiom (SURVEY.md §4): N identical processes, one coordinator
 address, no roles. Run by tests/test_multiprocess.py:
 
-    python tests/_mp_worker.py <process_id> <num_processes> <port>
+    python tests/_mp_worker.py <process_id> <num_processes> <port> [mode]
 
-Prints one JSON line with a digest of the final params; the launcher asserts
-every process converged to bit-identical replicated state.
+mode "dp" (default): LeNet sync-DP over all devices. mode "tp": tiny BERT
+on the PRODUCTION cross-host layout — the data axis spans the processes
+while the model (tensor-parallel) axis stays inside each process's local
+devices, so row-parallel psums ride process-local links and only the DP
+pmean crosses the process boundary. Prints one JSON line with a digest of
+the final replicated params; the launcher asserts every process converged
+to bit-identical replicated state.
 """
 
 import json
@@ -16,6 +21,7 @@ import sys
 
 def main() -> int:
     proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
 
     import jax
 
@@ -52,6 +58,9 @@ def main() -> int:
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == 4 * nproc, len(jax.devices())
 
+    if mode == "tp":
+        return _tp_body(proc_id, nproc)
+
     mesh = build_mesh({"data": -1})
     model = LeNet5()
     params, model_state = init_model(
@@ -81,6 +90,109 @@ def main() -> int:
                 "proc": proc_id,
                 "digest": round(digest, 6),
                 "loss": loss,
+                "step": int(state.step),
+                "n_devices": len(jax.devices()),
+            }
+        )
+    )
+    return 0
+
+
+def _tp_body(proc_id: int, nproc: int) -> int:
+    """Tiny BERT, mesh data x model with the model axis inside each process
+    (canonical axis order puts "data" outermost, so with 4 local devices
+    and model=4 every TP group is process-local). Digests the REPLICATED
+    leaves (embeddings, LN, post-psum biases) — identical across processes
+    iff cross-process DP and within-process TP both stayed in lockstep."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_tensorflow_tpu.data.text import (
+        SyntheticMLM,
+        SyntheticMLMConfig,
+        bert_batch_specs,
+        mlm_device_batches,
+    )
+    from distributed_tensorflow_tpu.models.bert import (
+        BertConfig,
+        BertForPreTraining,
+        bert_param_specs,
+        make_bert_pretraining_loss,
+    )
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+    from distributed_tensorflow_tpu.train.step import (
+        _spec_axes,
+        make_state_specs,
+        place_state,
+    )
+
+    L = 32
+    mesh = build_mesh({"data": nproc, "model": 4})
+    cfg = BertConfig(
+        vocab_size=96,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        intermediate_size=64,
+        max_position=L,
+        dropout_rate=0.0,
+    )
+    variables = BertForPreTraining(cfg).init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )
+    params = jax.device_get(variables["params"])
+    tp_cfg = dataclasses.replace(cfg, model_axis="model", model_parallel=4)
+    tx = optax.adam(1e-3)
+    host_state = create_train_state(params, tx)
+    specs = make_state_specs(host_state, tx, bert_param_specs(params))
+    state = place_state(host_state, mesh, specs)
+    step = make_train_step(
+        make_bert_pretraining_loss(BertForPreTraining(tp_cfg)),
+        tx,
+        mesh,
+        batch_spec=bert_batch_specs(mesh),
+        state_specs=specs,
+        clip_norm=0.05,  # active clipping exercises the spec-aware path
+    )
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+    batches = mlm_device_batches(data, mesh, 8 * nproc, seed=3)
+    loss = grad_norm = None
+    for _ in range(3):
+        state, metrics = step(state, next(batches), jax.random.key(1))
+        loss = float(metrics["loss"])
+        grad_norm = float(metrics["grad_norm"])
+
+    # Replicated leaves are fully addressable on every process; sharded
+    # leaves are not, so digest only the replicated subtree.
+    from jax.sharding import PartitionSpec as P
+
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    digest = 0.0
+    n_replicated = 0
+    for leaf, spec in zip(
+        jax.tree.leaves(state.params),
+        jax.tree.leaves(specs.params, is_leaf=is_spec),
+    ):
+        if not _spec_axes(spec):
+            digest += float(np.abs(np.asarray(jax.device_get(leaf))).sum())
+            n_replicated += 1
+    print(
+        json.dumps(
+            {
+                "proc": proc_id,
+                "digest": round(digest, 6),
+                "loss": loss,
+                "grad_norm": grad_norm,
+                "n_replicated": n_replicated,
                 "step": int(state.step),
                 "n_devices": len(jax.devices()),
             }

@@ -65,6 +65,51 @@ def test_two_process_sync_dp_localhost():
     assert outs[0]["loss"] == outs[1]["loss"], outs
 
 
+def test_two_process_tensor_parallel_localhost():
+    """Cross-host TP on the production layout: the data axis spans the two
+    real processes while each process's 4 local devices form one TP group
+    (row-parallel psums never cross the process boundary; only the DP
+    pmean does). Replicated leaves — which the spec-aware clipping and the
+    per-leaf grad contract must keep in lockstep — digest bit-identically
+    on both processes, with active global-norm clipping in the loop."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(_REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_REPO / "tests" / "_mp_worker.py"),
+             str(i), "2", str(port), "tp"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=str(_REPO),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert {o["proc"] for o in outs} == {0, 1}
+    for o in outs:
+        assert o["n_devices"] == 8
+        assert o["step"] == 3
+        assert o["n_replicated"] > 0
+    assert outs[0]["digest"] == outs[1]["digest"], outs
+    assert outs[0]["loss"] == outs[1]["loss"], outs
+    assert outs[0]["grad_norm"] == outs[1]["grad_norm"], outs
+
+
 def test_two_process_native_input_matches_single_process_stream():
     """The C++ pipeline's multi-host disjointness contract, cross-process
     (VERDICT r2 Missing #5): two real processes feed native_device_batches
