@@ -33,6 +33,69 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+_COLLECTIVE_PRIMS = frozenset({
+    # jax._src.lax.parallel primitives, enumerated against jax 0.9.0.
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+    "psum_invariant", "ragged_all_to_all", "psend", "precv",
+    "all_gather_invariant", "all_gather_reduced", "unreduced_psum",
+    "unreduced_reduce_scatter",
+})
+
+
+def _jaxpr_has_collectives(jaxpr) -> bool:
+    """True if any eqn (recursively, incl. scan/cond bodies) is a collective."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            return True
+        for v in eqn.params.values():
+            for sub in _subjaxprs(v):
+                if _jaxpr_has_collectives(sub):
+                    return True
+    return False
+
+
+def _subjaxprs(v):
+    import jax.extend.core as jex_core
+
+    if isinstance(v, jex_core.ClosedJaxpr):
+        yield v.jaxpr
+    elif isinstance(v, jex_core.Jaxpr):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _subjaxprs(item)
+
+
+def _layer_fn_has_collectives(layer_fn, stacked_params, h0, with_context) -> bool:
+    """Trace one layer call — forward AND backward — and scan the jaxpr for
+    collectives.
+
+    Decides whether bubble masking is safe (see ``pipeline_apply``): a
+    collective inside a branch that only part of the pipeline takes is
+    undefined, so any hit forces the unconditional schedule. The backward
+    must be traced too: ``pipeline_apply`` is differentiated through, and a
+    ``custom_vjp`` layer op can be collective-free forward with a psum in
+    its bwd rule. Conservative on a failed trace (collectives assumed).
+    """
+    p_one = jax.tree.map(lambda leaf: leaf[0], stacked_params)
+
+    def probe(p, h):
+        if with_context:
+            ctx = {"layer": jnp.int32(0), "microbatch": jnp.int32(0)}
+            fn = lambda p_, h_: layer_fn(p_, h_, ctx)  # noqa: E731
+        else:
+            fn = layer_fn
+        out, vjp = jax.vjp(fn, p, h)
+        return vjp(jax.tree.map(jnp.ones_like, out))
+
+    try:
+        jaxpr = jax.make_jaxpr(probe)(p_one, h0)
+    except Exception:
+        return True
+    return _jaxpr_has_collectives(jaxpr.jaxpr)
+
+
 # layer_fn(layer_params, activations) -> activations, applied per layer.
 # With with_context=True the signature is layer_fn(layer_params, activations,
 # ctx) where ctx = {"layer": global layer index, "microbatch": microbatch
@@ -51,6 +114,7 @@ def pipeline_apply(
     n_microbatches: int,
     with_context: bool = False,
     with_aux: bool = False,
+    mask_bubble: bool | str = "auto",
 ):
     """Run a stage-sharded layer stack over ``x`` with GPipe microbatching.
 
@@ -79,6 +143,22 @@ def pipeline_apply(
     Returns:
       ``[B, ...]`` (with ``with_aux``: a ``(y, aux_mean)`` tuple) — the
       stack's output, identical on every stage.
+
+    ``mask_bubble`` wraps each tick's stage compute in a ``lax.cond`` on
+    tick validity so fill/drain ticks skip the layer math entirely instead
+    of computing clamped garbage — ~(S-1)/(M+S-1) of each stage's tick work.
+    The default ``"auto"`` enables it only when ``layer_fn`` contains no
+    collectives: stages diverge on the branch at every fill/drain tick, and
+    a sub-mesh collective inside the untaken branch is undefined —
+    measured, not conjectured: a ``ppermute`` ring over a "seq" axis inside
+    the cond silently corrupts its payload on the CPU mesh (the pair list
+    spans devices that never execute the instruction), and a real pod could
+    just as well deadlock. Grouped collectives (psum's disjoint
+    replica_groups) happen to survive on CPU, but with no multi-chip
+    hardware to prove it on, "auto" stays conservative: ANY collective in
+    ``layer_fn`` keeps the unconditional schedule. Pass True/False to
+    override (True with collectives is on you); scripts/pp_flops.py
+    measures the executed-FLOP delta.
     """
     S = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -113,22 +193,47 @@ def pipeline_apply(
         )
         return h, aux_sum
 
+    if mask_bubble not in (True, False, "auto"):
+        raise ValueError(
+            f"mask_bubble must be True, False, or 'auto'; got {mask_bubble!r}"
+        )
+    if mask_bubble == "auto":
+        mask_bubble = not _layer_fn_has_collectives(
+            layer_fn, stacked_params, mb[0], with_context
+        )
+
     def tick(carry, t):
         buf, aux_acc = carry
-        # Stage 0 ingests microbatch t (clamped in the drain phase — those
-        # ticks compute garbage that is never collected); later stages take
-        # the neighbor's value that arrived on the previous tick. Stage s
-        # processes microbatch t - s on tick t (clamped the same way).
+        # Stage 0 ingests microbatch t (clamped in the drain phase); later
+        # stages take the neighbor's value that arrived on the previous
+        # tick. Stage s processes microbatch t - s on tick t.
         inject = lax.dynamic_index_in_dim(
             mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
         )
         h_in = jnp.where(stage == 0, inject, buf)
         mb_idx = jnp.clip(t - stage, 0, M - 1)
-        h_out, aux_tick = run_stage(h_in, mb_idx)
-        # Fill/drain ticks process clamped garbage — their aux must not
-        # pollute the loss. Valid iff this stage holds a REAL microbatch.
+        # Valid iff this stage holds a REAL microbatch this tick. Fill ticks
+        # (t < stage) and drain ticks (t - stage >= M) would otherwise run
+        # the stage on clamped garbage that is never collected; gating the
+        # whole stage in a lax.cond skips that compute at runtime. The
+        # pass-through branch is exact: a buffer consumed at (s, t) always
+        # came from a compute at (s-1, t-1), and valid(s-1, t-1) ==
+        # valid(s, t), so no consumed value ever flows through the skip
+        # branch (stage 0 reads `inject`, never the wrapped-around buf).
         valid = ((t - stage) >= 0) & ((t - stage) < M)
-        aux_acc = aux_acc + jnp.where(valid, aux_tick, 0.0)
+        if mask_bubble:
+            h_out, aux_tick = lax.cond(
+                valid,
+                lambda h, i: run_stage(h, i),
+                lambda h, i: (h, jnp.float32(0.0)),
+                h_in,
+                mb_idx,
+            )
+        else:
+            h_out, aux_tick = run_stage(h_in, mb_idx)
+            # Garbage ticks' aux must not pollute the loss.
+            aux_tick = jnp.where(valid, aux_tick, 0.0)
+        aux_acc = aux_acc + aux_tick
         buf_next = lax.ppermute(h_out, axis_name, fwd_perm)
         return (buf_next, aux_acc), h_out
 

@@ -1,0 +1,101 @@
+"""Measure the executed-FLOP drop from GPipe bubble masking (VERDICT r4 #4).
+
+``pipeline_apply(mask_bubble=True)`` wraps each tick's stage compute in a
+``lax.cond`` on tick validity, so fill/drain ticks skip the layer math they
+used to spend on clamped garbage microbatches. Ideal saving: every stage
+runs M real ticks out of T = M + S - 1, so executed stage compute drops by
+(S-1)/(M+S-1) — 3/7 ≈ 43% at (S=4, M=4), 3/19 ≈ 16% at the recommended
+M = 4S.
+
+Evidence, on the 8-virtual-device CPU mesh (no TPU needed):
+  1. XLA's cost model on the compiled program (``compiled.cost_analysis()``)
+     — counts conditional branches by the TAKEN path only if it can prove
+     it, otherwise both; reported for transparency.
+  2. Wall-clock of a compute-heavy toy pipeline (matmul layers wide enough
+     that tick compute dominates the ppermutes) at mask_bubble on/off —
+     the executed-work ground truth.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python scripts/pp_flops.py
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from distributed_tensorflow_tpu.parallel.pipeline import pipeline_apply  # noqa: E402
+
+
+def build(mask_bubble: bool, S: int, M: int, B: int, D: int, n_layers: int):
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pipeline",))
+
+    def layer_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def fwd(stacked, x):
+        return pipeline_apply(
+            layer_fn, stacked, x, n_microbatches=M, mask_bubble=mask_bubble
+        )
+
+    fn = jax.jit(
+        jax.shard_map(
+            fwd,
+            mesh=mesh,
+            in_specs=(P("pipeline"), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    rng = np.random.default_rng(0)
+    stacked = {"w": jax.device_put(
+        rng.standard_normal((n_layers, D, D), np.float32) / np.sqrt(D),
+        NamedSharding(mesh, P("pipeline")))}
+    x = jax.device_put(rng.standard_normal((B, D), np.float32),
+                       NamedSharding(mesh, P()))
+    return fn, stacked, x
+
+
+def main():
+    S, M, B, D, n_layers = 4, 4, 64, 2048, 8
+    ideal_drop = (S - 1) / (M + S - 1)
+    print(f"S={S} M={M}: ideal executed-compute drop {ideal_drop:.1%}")
+    results = {}
+    for mask in (False, True):
+        fn, stacked, x = build(mask, S, M, B, D, n_layers)
+        lowered = fn.lower(stacked, x).compile()
+        ca = lowered.cost_analysis()
+        flops = (ca or {}).get("flops", float("nan"))
+        y = fn(stacked, x)
+        jax.block_until_ready(y)
+        t0 = time.perf_counter()
+        for _ in range(20):
+            y = fn(stacked, x)
+        jax.block_until_ready(y)
+        dt = (time.perf_counter() - t0) / 20
+        results[mask] = (flops, dt)
+        print(f"  mask_bubble={mask}: cost_analysis flops={flops:.3e}  "
+              f"wall={dt*1e3:.2f} ms")
+    f0, t0_ = results[False]
+    f1, t1_ = results[True]
+    print(f"flops ratio (masked/unmasked): {f1/f0:.3f}")
+    print(f"wall ratio  (masked/unmasked): {t1_/t0_:.3f} "
+          f"(ideal {1-ideal_drop:.3f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -174,3 +174,159 @@ def test_pipeline_rejects_bad_microbatch_split(devices8):
     )
     with pytest.raises(ValueError, match="not divisible"):
         jax.jit(run)(params["stack"], jnp.zeros((16, D)))
+
+def test_bubble_mask_trajectory_identical(devices8):
+    """mask_bubble=True (lax.cond skip of fill/drain ticks) is an execution
+    optimization, not a semantic change: forward outputs must be BITWISE
+    identical to the unconditional schedule (no consumed value ever flows
+    through the skip branch)."""
+    params = _init_params(jax.random.key(3))
+    x = np.random.default_rng(1).normal(size=(16, D)).astype(np.float32)
+    mesh = build_mesh({"pipeline": 4}, devices=jax.devices()[:4])
+    stack_specs = pipeline_param_specs(params["stack"])
+
+    def run(mask):
+        fn = jax.jit(
+            jax.shard_map(
+                lambda p, x: pipeline_apply(
+                    _layer_fn, p, x, n_microbatches=4, mask_bubble=mask
+                ),
+                mesh=mesh,
+                in_specs=(stack_specs, P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        return np.asarray(jax.device_get(fn(params["stack"], jnp.asarray(x))))
+
+    y_plain, y_masked = run(False), run(True)
+    np.testing.assert_array_equal(y_plain, y_masked)
+    # "auto" resolves to masked for this collective-free layer_fn.
+    y_auto = run("auto")
+    np.testing.assert_array_equal(y_plain, y_auto)
+
+
+def test_bubble_mask_auto_declines_collective_layers(devices8):
+    """The discovered failure mode, pinned: a sub-mesh collective (ppermute
+    ring over a second axis) inside the cond's taken branch corrupts data,
+    because its source-target pairs span devices that skipped the branch.
+    ``mask_bubble="auto"`` must detect the collective and fall back to the
+    unconditional schedule, keeping the output equal to mask_bubble=False."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pipeline", "seq"))
+
+    def ring_layer(p, h):
+        ring = lax.axis_size("seq")
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+        def body(carry, _):
+            acc, kv = carry
+            acc = acc + h * kv.sum(axis=1, keepdims=True)
+            return (acc, lax.ppermute(kv, "seq", perm)), None
+
+        (acc, _), _ = lax.scan(
+            body, (jnp.zeros_like(h), h @ p["w"]), None, length=ring
+        )
+        return jnp.tanh(acc)
+
+    rng = np.random.default_rng(0)
+    stacked = {"w": rng.standard_normal((4, D, D), np.float32) * 0.3}
+    x = rng.standard_normal((8, 8, D), np.float32)
+
+    def run(mask):
+        fn = jax.jit(
+            jax.shard_map(
+                lambda p, x: pipeline_apply(
+                    ring_layer, p, x, n_microbatches=4, mask_bubble=mask
+                ),
+                mesh=mesh,
+                in_specs=(P("pipeline"), P(None, "seq")),
+                out_specs=P(None, "seq"),
+                check_vma=False,
+            )
+        )
+        return np.asarray(jax.device_get(fn(stacked, jnp.asarray(x))))
+
+    np.testing.assert_array_equal(run(False), run("auto"))
+
+
+def test_collective_detection_decisions(devices8):
+    """Pin what 'auto' resolves to: the detector itself must say False for
+    the plain matmul layer, True for a ppermute layer, and True for a
+    custom_vjp op whose FORWARD is collective-free but whose bwd rule
+    psums — pipeline_apply is differentiated through, so the backward
+    jaxpr counts."""
+    from jax.sharding import Mesh
+
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        _layer_fn_has_collectives,
+    )
+
+    stacked = {
+        "w": jnp.zeros((4, D, D), jnp.float32),
+        "b": jnp.zeros((4, D), jnp.float32),
+    }
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pipeline", "seq"))
+
+    def ring_layer(p, h):
+        return lax.ppermute(
+            h @ p["w"], "seq", [(0, 1), (1, 0)]
+        )
+
+    @jax.custom_vjp
+    def sneaky(w, h):
+        return h @ w
+
+    def sneaky_fwd(w, h):
+        return h @ w, (w, h)
+
+    def sneaky_bwd(res, g):
+        w, h = res
+        return (h.T @ g, lax.psum(g @ w.T, "seq"))
+
+    sneaky.defvjp(sneaky_fwd, sneaky_bwd)
+
+    def sneaky_layer(p, h):
+        return sneaky(p["w"], h)
+
+    def run_detector(layer_fn):
+        out = {}
+
+        def probe(p, h):
+            out["r"] = _layer_fn_has_collectives(layer_fn, p, h, False)
+            return h
+
+        jax.jit(
+            jax.shard_map(
+                probe,
+                mesh=mesh,
+                in_specs=(P("pipeline"), P(None, "seq")),
+                out_specs=P(None, "seq"),
+                check_vma=False,
+            )
+        ).lower(stacked, jnp.zeros((4, 8, D), jnp.float32))
+        return out["r"]
+
+    assert run_detector(_layer_fn) is False
+    assert run_detector(ring_layer) is True
+    assert run_detector(sneaky_layer) is True
+
+
+def test_mask_bubble_rejects_bad_value(devices8):
+    import pytest
+
+    params = _init_params(jax.random.key(4))
+    mesh = build_mesh({"pipeline": 8})
+    run = jax.shard_map(
+        lambda p, x: pipeline_apply(
+            _layer_fn, p, x, n_microbatches=4, mask_bubble="off"
+        ),
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(params["stack"]), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="mask_bubble"):
+        jax.jit(run)(params["stack"], jnp.zeros((16, D)))
