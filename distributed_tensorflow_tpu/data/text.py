@@ -302,6 +302,13 @@ def bert_batch_specs(
     }
 
 
+# Fixed generation granularity for mlm_device_batches: global row r of batch
+# k always comes from chunk r // _ROW_CHUNK, whatever the host count. Every
+# per-host slice must align to it (batch sizes are powers of two >= 8
+# throughout).
+_ROW_CHUNK = 8
+
+
 def mlm_device_batches(
     dataset: SyntheticMLM,
     mesh,
@@ -332,18 +339,57 @@ def mlm_device_batches(
     if expert_sharded and "expert" in mesh.axis_names:
         dp = dp + ("expert",)
     dp_spec = dp if dp else None
-    local_b = local_batch_size(
-        global_batch, mesh, extra_axes=("expert",) if expert_sharded else ()
+    # With NO row-sharding axes the batch is replicated: every process must
+    # materialize the FULL global batch (the equal-slice-per-host rule of
+    # local_batch_size applies only when the row dim actually shards across
+    # hosts — r5 cross-process pipeline rehearsal fix).
+    local_b = (
+        local_batch_size(
+            global_batch, mesh, extra_axes=("expert",) if expert_sharded else ()
+        )
+        if dp
+        else global_batch
     )
     seq = "seq" if (seq_sharded and "seq" in mesh.axis_names) else None
     spec_2d = NamedSharding(mesh, P(dp_spec, seq))
     spec_1d = NamedSharding(mesh, P(dp_spec))
-    proc = jax.process_index()
+    # HOST-COUNT-INVARIANT stream (r5): global batch k, row r is a pure
+    # function of (seed, k, r // _ROW_CHUNK) — each host generates exactly
+    # the fixed-size row chunks covering ITS contiguous slice, so one
+    # process on a virtual mesh and N processes on a pod see the SAME
+    # global data (the contract the native C++ pipeline already meets via
+    # its shared epoch permutation, and what the cross-process pp/ep
+    # rehearsals assert). The earlier per-process seeding made the stream
+    # depend on topology — and handed different "replicated" batches to
+    # different hosts in the no-data-axis case.
+    start_row = jax.process_index() * local_b if dp else 0
+    stop_row = start_row + local_b
+    if start_row % _ROW_CHUNK or (
+        local_b % _ROW_CHUNK and stop_row != global_batch
+    ):
+        raise ValueError(
+            f"per-host batch {local_b} (offset {start_row}) must align to "
+            f"the {_ROW_CHUNK}-row generation chunk"
+        )
+    # Chunk c's size is fixed by the GLOBAL batch (the final chunk may be
+    # partial) — generation stays topology-invariant because every host
+    # sizes chunk c identically.
+    chunk_sizes = [
+        (c, min(_ROW_CHUNK, global_batch - c * _ROW_CHUNK))
+        for c in range(start_row // _ROW_CHUNK, -(-stop_row // _ROW_CHUNK))
+    ]
     # Stream-position indexed: batch k is a pure function of (seed, k), so a
     # restored run resumes at batch N instead of replaying 0..N-1.
     step = start_step
     while True:
-        local = dataset.batch(local_b, seed=(seed, step, proc))
+        chunks = [
+            dataset.batch(size, seed=(seed, step, c))
+            for c, size in chunk_sizes
+        ]
+        local = {
+            k: np.concatenate([c[k] for c in chunks], axis=0)
+            for k in chunks[0]
+        }
         yield {
             k: jax.make_array_from_process_local_data(
                 spec_1d if v.ndim == 1 else spec_2d, v

@@ -21,6 +21,11 @@ TPU-first design notes:
   replicas bit-identical — the invariant of SURVEY.md §3d. Per-shard ghost
   batch norm is therefore the normalization semantics (SURVEY.md §7
   hard-part 5), matching per-worker BN in the reference's multi-worker runs.
+  Quantified (r5): 8-way-DP ResNet-20 vs the 1-device 8x-batch trajectory
+  measures 0.040 max-abs param drift / 0.033 loss drift after 20 steps at
+  global batch 128 (per-shard BN batches of 16); EMA means still match the
+  full-batch run (mean of equal shard means == global mean) — pinned with
+  2x-margin tolerances by tests/test_resnet.py::test_ghost_bn_drift_quantified.
 - ``kernel_init`` is He-normal like the reference era's MSRA init.
 """
 

@@ -30,11 +30,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 _NEG = -1e30
-# Block defaults from the r3 TPU sweep (scripts/flash_bench.py): a large
-# K/V block (few online-softmax rescale rounds, big MXU tiles) dominates;
-# bq=256/bk=512 is within a few % of per-L optimum at both 512 and 2048
-# and beats dense attention ~1.8-2.2x at BERT-base geometry.
-_DEFAULT_BLOCK_Q = 256
+# Base-2 softmax domain (r5): folding log2(e) into the score scale turns
+# every VPU exp into the cheaper exp2 — measured 4% off the fwd kernel
+# (1.043 -> 0.998 ms at bh=576, L=512) at |o| diff <= 1 bf16 ulp. The
+# saved lse stays in NATURAL log (public contract for the ring merge);
+# kernels convert at their boundaries.
+_LOG2E = math.log2(math.e)
+# Block defaults re-swept in r5 at the production geometry (bh=576, L=512,
+# D=64 — the L=512 b=48 BERT config): bq = bk = 512 wins every kernel
+# (fwd 1.145 -> 1.01 ms, dq 1.164 -> 0.894, dkv 1.639 -> 1.109 per layer;
+# /tmp-sweep recorded in docs/PERF.md r5). At L <= 512 that means ONE
+# whole-sequence tile per program — fewer programs, zero online-softmax
+# rescale rounds; at longer L the q/k loops re-engage with 512-sized
+# blocks (the r3 L=2048 sweep also preferred 512/512).
+_DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
 
 
@@ -89,15 +98,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k, scale
         o, m, denom = carry
         k_blk = k_ref[pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[pl.ds(j * block_k, block_k), :]
-        s = scale * jax.lax.dot_general(
+        # Scores land directly in the base-2 domain (scale * log2e folded).
+        s = (scale * _LOG2E) * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK] f32
         mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
         s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp2(s - m_new[:, None])
         p = p * mask_blk[None, :]
-        corr = jnp.exp(m - m_new)
+        corr = jnp.exp2(m - m_new)
         denom = denom * corr + jnp.sum(p, axis=-1)
         o = o * corr[:, None] + jax.lax.dot_general(
             p.astype(v_blk.dtype),
@@ -113,8 +123,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k, scale
     o, m, denom = jax.lax.fori_loop(0, l // block_k, body, (o, m, denom))
     safe = jnp.maximum(denom, 1e-37)
     o_ref[:] = (o / safe[:, None]).astype(o_ref.dtype)
-    # logsumexp per query row; fully-masked rows get _NEG (o stays 0).
-    lse_ref[0, :] = jnp.where(denom > 0, m + jnp.log(safe), _NEG)
+    # Natural-log logsumexp per query row (ln(denom * 2^m)); fully-masked
+    # rows get _NEG (o stays 0).
+    lse_ref[0, :] = jnp.where(denom > 0, m / _LOG2E + jnp.log(safe), _NEG)
 
 
 def _fwd(q, k, v, mask, block_q, block_k, interpret):
@@ -163,11 +174,17 @@ def _bwd_dq_kernel(
         k_blk = k_ref[pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[pl.ds(j * block_k, block_k), :]
         mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
-        s = scale * jax.lax.dot_general(
+        # P recomputed in the base-2 domain (see _fwd_kernel); the natural-
+        # domain derivative ds = p * (dp - delta) is unchanged.
+        s = (scale * _LOG2E) * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
-        p = jnp.exp(s - lse[:, None]) * mask_blk[None, :]
+        # Mask in the SCALED domain: a fully-masked row carries lse = _NEG
+        # (natural log), so the recompute must cancel _NEG * _LOG2E against
+        # _NEG * _LOG2E exactly — masking with plain _NEG would make the
+        # difference +4e29 and exp2 of it inf (NaN after the mask multiply).
+        s = jnp.where(mask_blk[None, :] != 0, s, _NEG * _LOG2E)
+        p = jnp.exp2(s - (_LOG2E * lse)[:, None]) * mask_blk[None, :]
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -201,11 +218,12 @@ def _bwd_dkv_kernel(
         do_blk = do_ref[pl.ds(i * block_q, block_q), :]
         lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
         delta_blk = delta_ref[0, pl.ds(i * block_q, block_q)]
-        s = scale * jax.lax.dot_general(
+        s = (scale * _LOG2E) * jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
-        s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
-        p = jnp.exp(s - lse_blk[:, None]) * mask_blk[None, :]
+        )  # [BQ, BK] base-2 domain (see _fwd_kernel)
+        # Scaled-domain mask value — see _bwd_dq_kernel.
+        s = jnp.where(mask_blk[None, :] != 0, s, _NEG * _LOG2E)
+        p = jnp.exp2(s - (_LOG2E * lse_blk)[:, None]) * mask_blk[None, :]
         p_lo = p.astype(do_blk.dtype)
         dv = dv + jax.lax.dot_general(
             p_lo, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32

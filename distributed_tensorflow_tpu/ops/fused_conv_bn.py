@@ -307,6 +307,14 @@ def conv1x1_bn_act(
         x4 = x4[:, ::strides, ::strides, :]
     b, h, w, k = x4.shape
     n = kernel.shape[1]
+    if not fused_supported(h * w * b, k, n):
+        # Fail loudly here instead of an opaque TypeError from _tile_m()
+        # being None deep inside the backward grid computation.
+        raise ValueError(
+            f"conv1x1_bn_act: shape (M={h * w * b}, K={k}, N={n}) is outside "
+            "the fused kernel family's supported range; gate callers on "
+            "fused_supported(m, k, n)"
+        )
     # H,W,B,C flatten: a bitcast for XLA:TPU's {3,0,2,1} conv layouts at
     # C >= 128 (docs/PERF.md r3 — B,H,W,C order costs a materialized
     # relayout copy per boundary).

@@ -50,7 +50,16 @@ def dense_attention(q, k, v, mask=None):
     ).astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name: str, mask=None, *, inner: str = "einsum"):
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    mask=None,
+    *,
+    inner: str = "einsum",
+    carry_dtype=None,
+):
     """Exact attention with Q sharded and K/V streamed around ``axis_name``.
 
     Args:
@@ -65,12 +74,26 @@ def ring_attention(q, k, v, axis_name: str, mask=None, *, inner: str = "einsum")
         ``flash_attention_block``) and merges blocks by logsumexp — the
         O(L_local)-memory inner step for rings whose local score block
         would not fit.
+      carry_dtype: dtype the K/V blocks ride the ring in. ``None`` (default)
+        keeps the storage dtype: bf16 inputs hop in bf16 — half the ICI
+        bytes of an f32 carry — at the cost of the BACKWARD rounding each
+        hop's dK/dV cotangent to bf16 before the scan accumulates it, so
+        gradient rounding grows ~O(sqrt(ring)) * 2^-8 relative (random-sign
+        accumulation; pinned by tests/test_ring_attention.py at small
+        rings). Rule of thumb: fine through ring <= 16; for longer rings —
+        or bf16 training that proves grad-noise-sensitive — pass
+        ``jnp.float32`` to carry (and accumulate) exactly, doubling SP
+        traffic (docs/PERF.md SP table: ring bytes double, still matching
+        Ulysses' bf16 bytes).
 
     Returns:
       ``[B, L_local, H, D]`` — this device's query shard attended over the
       *global* sequence, bit-comparable to :func:`dense_attention` on the
       gathered arrays (up to f32 reduction order).
     """
+    if carry_dtype is not None:
+        k = k.astype(carry_dtype)
+        v = v.astype(carry_dtype)
     if inner == "flash":
         return _ring_attention_flash(q, k, v, axis_name, mask)
     if inner != "einsum":
@@ -158,7 +181,13 @@ def _ring_attention_flash(q, k, v, axis_name: str, mask=None):
 
     def one_block(carry, _):
         k_blk, v_blk, mask_blk, acc, m, z = carry
-        o_j, lse_j = flash_attention_block(q, k_blk, v_blk, mask_blk)
+        # Cast to the query/storage dtype AT the kernel call: with an f32
+        # carry_dtype the blocks ride (and their cotangents accumulate) in
+        # f32, while the Pallas kernel still sees bf16 operands (f32 MXU
+        # passes are ~8x slower — the r2 mistake; see flash_attention.py).
+        o_j, lse_j = flash_attention_block(
+            q, k_blk.astype(q.dtype), v_blk.astype(q.dtype), mask_blk
+        )
         m_new = jnp.maximum(m, lse_j)
         w_old = jnp.exp(m - m_new)
         w_j = jnp.exp(lse_j - m_new)

@@ -9,5 +9,9 @@ AllReduce inside it.
 """
 
 from distributed_tensorflow_tpu.train.state import TrainState, create_train_state  # noqa: F401
-from distributed_tensorflow_tpu.train.step import make_train_step, make_eval_step  # noqa: F401
+from distributed_tensorflow_tpu.train.step import (  # noqa: F401
+    make_eval_step,
+    make_rng,
+    make_train_step,
+)
 from distributed_tensorflow_tpu.train.loop import fit  # noqa: F401

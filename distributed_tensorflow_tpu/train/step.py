@@ -87,6 +87,24 @@ def _extra_batch_axes(batch_spec, dp_axes) -> tuple[str, ...]:
     )
 
 
+def make_rng(seed: int, impl: str = "auto") -> jax.Array:
+    """The per-step rng key under the framework's PRNG policy.
+
+    ``"auto"`` = rbg on TPU (the counter-based hardware generator; dropout
+    bit generation via software threefry measured +36 ms/step on BERT-base
+    L=512 b=48 — docs/PERF.md r5 — and the reference's TF dropout used the
+    same Philox family), threefry elsewhere (bit-stable across versions and
+    backends). One definition shared by the CLI trainer and every benchmark
+    so "the benched step is the production step" stays true by
+    construction.
+    """
+    if impl == "auto":
+        impl = "rbg" if jax.devices()[0].platform == "tpu" else "threefry2x32"
+    elif impl == "threefry":
+        impl = "threefry2x32"
+    return jax.random.key(seed, impl=impl)
+
+
 def make_train_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,

@@ -92,8 +92,18 @@ def bench_config(
     )["params"]
     tx = optax.adamw(1e-4, weight_decay=0.01)
     state = place_state(create_train_state(params, tx, {}), mesh)
-    step = make_train_step(make_bert_pretraining_loss(model), tx, mesh)
-    rng = jax.random.key(0)
+    # clip_norm=1.0: the canonical BERT recipe the CLI preset trains with —
+    # the benched step is the production step (r5; earlier rounds measured
+    # without the clip reduce, a ~1 ms/step difference).
+    step = make_train_step(
+        make_bert_pretraining_loss(model), tx, mesh, clip_norm=1.0
+    )
+    # The trainer's PRNG policy (rbg on TPU): dropout RNG is real work at
+    # this geometry — threefry costs +36 ms/step (245.1 vs 208.9 ms
+    # measured r5, L=512 b=48) generating ~100M dropout bits in software.
+    from distributed_tensorflow_tpu.train import make_rng
+
+    rng = make_rng(0)
 
     def window(k):
         nonlocal state
@@ -135,9 +145,11 @@ def main():
 
 
 def driver_line():
-    """One-line JSON for the driver protocol (bench.py BENCH_WORKLOAD=bert)."""
+    """One-line JSON for the driver protocol (bench.py's r5 default)."""
     # b=48/chip won the r4 L=512 batch sweep (mfu 0.331 @ 24, 0.360 @ 48,
-    # 0.353 @ 64, 0.324 @ 96 — docs/PERF.md r4).
+    # 0.353 @ 64, 0.324 @ 96 — docs/PERF.md r4); the r5 campaign lifted the
+    # same config via rbg dropout rng, bf16-logit CE, tanh gelu, 512/512
+    # flash blocks, exp2 softmax (docs/PERF.md r5 bucket tables).
     r = bench_config(512, 48, attn_impl="auto")  # auto -> flash at L=512
     dev = jax.devices()[0]
     print(
@@ -146,8 +158,10 @@ def driver_line():
                 "metric": "bert_base_train_tokens_per_sec_per_chip",
                 "value": r["tokens_per_sec_per_chip"],
                 "unit": f"tokens/sec/chip (bf16, L=512, b={r['per_chip_batch']}/chip, "
-                f"flash attn, {dev.device_kind}, mfu={r['mfu']:.3f}, "
-                f"median windows, spread={r['spread']:.1%}, peak=197T)",
+                f"flash attn, AdamW+clip1.0, rbg dropout rng, {dev.device_kind}, "
+                f"mfu={r['mfu']:.3f}, median windows, spread={r['spread']:.1%}, "
+                f"peak=197T; conv context: resnet50 mfu~0.17 structural plateau "
+                f"via BENCH_WORKLOAD=resnet50, docs/PERF.md)",
                 "vs_baseline": round(r["mfu"] / 0.55, 4),
             }
         )

@@ -39,24 +39,12 @@ from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from hlo_breakdown import DEF_RE, load_trace, shape_bytes  # noqa: E402
+from hlo_breakdown import load_trace, shape_bytes  # noqa: E402
 
 MATMUL_PEAK = 196.4e12  # measured, scripts/roofline.py r3
 STREAM_BW = 650e9       # measured streaming HBM rate, r3
 
-DOT_RE = re.compile(
-    r"^\s*(?:ROOT )?%?([\w\.\-]+) = (bf16|f32)\[([\d,]*)\][^ ]* dot\("
-    r"%?([\w\.\-]+)(?:\.clone)?, %?([\w\.\-]+)\), (.*)$")
-LHS_C_RE = re.compile(r"lhs_contracting_dims=\{([\d,]*)\}")
-# XLA:TPU canonicalizes every matmul to a 1-D/2-D convolution; FLOPs =
-# 2 * prod(out) * prod(window) * lhs_feature (hlo_breakdown.py's formula,
-# verified across all dim_labels forms XLA emits).
-CONV_RE = re.compile(
-    r"^\s*(?:ROOT )?%?([\w\.\-]+) = (bf16|f32)\[([\d,]*)\][^ ]* convolution\("
-    r"%?([\w\.\-]+), %?([\w\.\-]+)\), window=\{size=([\dx]+)[^}]*\}, "
-    r"dim_labels=(\w+)_(\w+)->(\w+)")
 OPNAME_RE = re.compile(r'op_name="([^"]*)"')
-COMP_RE = re.compile(r"^(?:ENTRY )?%?([\w\.\-]+) (?:\([^)]*\) )?->.*\{$|^%?([\w\.\-]+) \{$")
 
 
 def classify(op_name: str) -> str:
@@ -228,7 +216,7 @@ def build_step(L: int, b: int, attn_impl: str, num_layers: int | None = None,
     if remat:
         import jax as _jax
         loss = _jax.checkpoint(loss, static_argnums=())
-    step = make_train_step(loss, tx, mesh)
+    step = make_train_step(loss, tx, mesh, clip_norm=1.0)
     return step, state, batch, cfg
 
 
@@ -249,8 +237,10 @@ def main():
 
     import jax
 
+    from distributed_tensorflow_tpu.train import make_rng
+
     step, state, batch, cfg = build_step(args.L, args.batch, args.attn, args.layers)
-    rng = jax.random.key(0)
+    rng = make_rng(0)
     print("compiling ...", flush=True)
     t0 = time.perf_counter()
     compiled = step.lower(state, batch, rng).compile()

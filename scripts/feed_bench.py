@@ -1,0 +1,111 @@
+"""Host-side throughput of the native C++ input pipeline (VERDICT r4 #2).
+
+Measures what the r4 ImageNet runs never did: batches/s of real
+augmentation work (random-resized-crop + flip + per-channel normalize for
+the ResNet-50 preset; same at 299px for Inception) from a u8 memmap cache,
+with NO TPU in the loop. The dev host for these rounds has exactly ONE
+usable core (os.cpu_count() == 1 — the honest reason r4 leaned on
+--device-pool), so the deliverable is per-core img/s plus the core count a
+real TPU VM needs to hit the measured device rates:
+
+    feed_cores_needed = device_img_per_sec / img_per_sec_per_core
+
+A v5e host exposes ~24 vCPUs per chip (112-vCPU host / 4 chips + OS
+overhead — google cloud docs ct5lp-hightpu-4t), so the question "can the
+feed sustain the device rate" reduces to whether feed_cores_needed fits
+comfortably under ~24.
+
+    python scripts/feed_bench.py [--images 2048] [--batches 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_tensorflow_tpu.data.native import (  # noqa: E402
+    NativePipeline,
+    native_available,
+)
+
+# Measured r4 device rates (BENCH_r04.json / docs/PERF.md): what the feed
+# must sustain per chip.
+DEVICE_RATES = {"resnet50_224": 2752.0, "inception_299": 2026.0}
+
+
+def bench(out_hw: int, images: np.ndarray, labels: np.ndarray, batch: int,
+          n_batches: int, n_threads: int) -> dict:
+    pipe = NativePipeline(
+        images,
+        labels,
+        batch,
+        out_size=(out_hw, out_hw),
+        rrc=True,
+        flip=True,
+        mean=np.array([0.485, 0.456, 0.406], np.float32) * 255.0,
+        stddev=np.array([0.229, 0.224, 0.225], np.float32) * 255.0,
+        seed=0,
+        n_threads=n_threads,
+        queue_cap=4,
+    )
+    try:
+        it = iter(pipe)
+        next(it)  # warm the pool + first staging
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            next(it)
+        dt = time.perf_counter() - t0
+    finally:
+        pipe.close()
+    img_s = batch * n_batches / dt
+    return {"out": out_hw, "threads": n_threads,
+            "batches_per_s": round(n_batches / dt, 3),
+            "img_per_s": round(img_s, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=2048)
+    ap.add_argument("--batches", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--src-hw", type=int, default=256)
+    args = ap.parse_args()
+
+    if not native_available():
+        print(json.dumps({"error": "native pipeline unavailable"}))
+        return
+
+    cores = len(os.sched_getaffinity(0))
+    print(f"host cores available: {cores}")
+    rng = np.random.default_rng(0)
+    # u8 source cache, memmap-backed like the real ImageNet path.
+    with tempfile.NamedTemporaryFile(suffix=".u8") as f:
+        arr = np.memmap(f.name, dtype=np.uint8, mode="w+",
+                        shape=(args.images, args.src_hw, args.src_hw, 3))
+        arr[:] = rng.integers(0, 256, arr.shape, dtype=np.uint8)
+        labels = rng.integers(0, 1000, args.images).astype(np.int32)
+
+        for out_hw, key in ((224, "resnet50_224"), (299, "inception_299")):
+            for n_threads in sorted({1, cores}):
+                r = bench(out_hw, arr, labels, args.batch, args.batches,
+                          n_threads)
+                need = DEVICE_RATES[key] / (r["img_per_s"] / n_threads)
+                r.update({
+                    "preset": key,
+                    "device_img_per_s": DEVICE_RATES[key],
+                    "img_per_s_per_core": round(r["img_per_s"] / n_threads, 1),
+                    "cores_needed_for_device_rate": round(need, 1),
+                })
+                print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,6 @@ Evidence, on the 8-virtual-device CPU mesh (no TPU needed):
 
 from __future__ import annotations
 
-import functools
 import os
 import sys
 import time

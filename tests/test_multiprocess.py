@@ -171,3 +171,89 @@ def test_two_process_native_input_matches_single_process_stream():
                 assert outs[proc][k] == h.hexdigest(), (proc, k)
     finally:
         pipe.close()
+
+
+def _launch_and_collect(mode: str, timeout: int = 360):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(_REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_REPO / "tests" / "_mp_worker.py"),
+             str(i), "2", str(port), mode],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=str(_REPO),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert {o["proc"] for o in outs} == {0, 1}
+    return outs
+
+
+def _reference_run(mode: str):
+    """The SAME training body on the pytest process's single-process
+    8-virtual-device mesh (conftest.py set it up) — the trajectory the
+    cluster must reproduce."""
+    sys.path.insert(0, str(_REPO / "tests"))
+    import _mp_worker
+
+    return (_mp_worker.pp_train if mode == "pp" else _mp_worker.ep_train)()
+
+
+def test_two_process_pipeline_parallel_localhost():
+    """Cross-process PIPELINE parallelism (VERDICT r4 #3): mesh
+    {pipeline: 8} puts stages 0-3 on process 0 and 4-7 on process 1, so
+    the GPipe ppermute hand-off (and its wraparound) crosses the real
+    process boundary on every tick. Both workers must agree bit-for-bit,
+    and the trajectory must equal the single-process virtual-mesh run."""
+    outs = _launch_and_collect("pp")
+    for o in outs:
+        assert o["n_devices"] == 8
+        assert o["step"] == 3
+        assert o["n_replicated"] > 0
+    assert outs[0]["digest"] == outs[1]["digest"], outs
+    assert outs[0]["losses"] == outs[1]["losses"], outs
+
+    ref = _reference_run("pp")
+    import numpy as np
+
+    np.testing.assert_allclose(outs[0]["losses"], ref["losses"], atol=1e-5)
+    np.testing.assert_allclose(
+        outs[0]["grad_norm"], ref["grad_norm"], rtol=1e-5
+    )
+    np.testing.assert_allclose(outs[0]["digest"], ref["digest"], atol=1e-4)
+
+
+def test_two_process_expert_parallel_localhost():
+    """Cross-process EXPERT parallelism (VERDICT r4 #3): token-sharded
+    GShard MoE on mesh {expert: 8} — the dispatch all_to_all routes
+    tokens between experts 0-3 (process 0) and 4-7 (process 1) across the
+    real boundary. Same contract as the pp rehearsal."""
+    outs = _launch_and_collect("ep")
+    for o in outs:
+        assert o["n_devices"] == 8
+        assert o["step"] == 3
+        assert o["n_replicated"] > 0
+    assert outs[0]["digest"] == outs[1]["digest"], outs
+    assert outs[0]["losses"] == outs[1]["losses"], outs
+
+    ref = _reference_run("ep")
+    import numpy as np
+
+    np.testing.assert_allclose(outs[0]["losses"], ref["losses"], atol=1e-5)
+    np.testing.assert_allclose(
+        outs[0]["grad_norm"], ref["grad_norm"], rtol=1e-5
+    )
+    np.testing.assert_allclose(outs[0]["digest"], ref["digest"], atol=1e-4)
