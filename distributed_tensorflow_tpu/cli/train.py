@@ -68,6 +68,7 @@ class WorkloadConfig:
     # "replicated" | "alltoall" (GShard a2a over replicated tokens) |
     # "sharded" (production GShard: batch sharded over the expert axis)
     moe_dispatch: str = "replicated"
+    moe_topk: int = 1  # routing fan-out: 1 = Switch, 2 = GShard top-2
     pipeline_parallel: int = 0  # >0: pipeline axis size, stage-sharded encoder (BERT)
     pipeline_microbatches: int = 0  # GPipe M; 0 -> 4 * pipeline_parallel
     bert_layers: int = 0  # >0: override encoder depth (smoke runs)
@@ -332,6 +333,7 @@ def _build_bert_workload(cfg_kwargs: dict):
                 init_cfg = dataclasses.replace(
                     init_cfg,
                     moe_experts=cfg.moe_experts,
+                    moe_topk=cfg.moe_topk,
                     moe_dispatch=(
                         "replicated"
                         if cfg.moe_dispatch == "sharded"
@@ -781,6 +783,10 @@ def main(argv: list[str] | None = None):
                         "exchange over replicated tokens; sharded = the "
                         "production GShard layout (batch sharded over the "
                         "expert axis, zero replicated non-MoE compute)")
+    parser.add_argument("--moe-topk", type=int, default=-1,
+                        help="routing fan-out: 1 = Switch top-1 (default), "
+                        "2 = GShard top-2 (renormalized gates, per-expert "
+                        "capacity unchanged)")
     parser.add_argument("--pipeline-parallel", type=int, default=-1,
                         help="pipeline-stage axis size for the BERT encoder "
                         "(GPipe schedule; 0 disables)")
@@ -851,6 +857,8 @@ def main(argv: list[str] | None = None):
         overrides["moe_experts"] = args.moe_experts
     if args.moe_dispatch:
         overrides["moe_dispatch"] = args.moe_dispatch
+    if args.moe_topk > 0:
+        overrides["moe_topk"] = args.moe_topk
     if args.expert_parallel >= 0:
         overrides["expert_parallel"] = args.expert_parallel
     if args.pipeline_parallel >= 0:

@@ -91,6 +91,11 @@ class BertConfig:
     # the local slice with no trailing all_gather. Requires the loaders'
     # expert_sharded batch layout (data/text.py bert_batch_specs).
     moe_dispatch: str = "replicated"
+    # Routing fan-out: 1 = Switch (top-1), 2 = GShard top-2 (renormalized
+    # gates, first-choice queue priority, per-expert capacity UNCHANGED —
+    # so top-2 doubles capacity pressure; parallel/moe.py
+    # switch_route_topk). Works with all three dispatch layouts.
+    moe_topk: int = 1
     # Pipeline parallelism (GPipe schedule, parallel/pipeline.py): with
     # ``pipeline_axis`` set the encoder's params are a stacked
     # ``[num_layers, ...]`` tree (created by nn.scan; shard dim 0 over the
@@ -307,6 +312,7 @@ class MoeFfn(nn.Module):
             # PAD positions must not consume routing capacity or bias the
             # load-balance aux — only attention-mask-valid tokens route.
             valid=None if mask is None else mask.reshape(b * l),
+            topk=cfg.moe_topk,
         )
         experts = {"w1": w1, "b1": b1, "w2": w2, "b2": b2}
         if cfg.moe_dispatch == "sharded":

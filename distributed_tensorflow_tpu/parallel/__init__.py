@@ -24,6 +24,7 @@ from distributed_tensorflow_tpu.parallel.moe import (  # noqa: F401
     moe_apply_a2a,
     stack_expert_params,
     switch_route,
+    switch_route_topk,
 )
 from distributed_tensorflow_tpu.parallel.ring_attention import (  # noqa: F401
     dense_attention,

@@ -4,7 +4,8 @@ The reference has no MoE (SURVEY.md §2 parallelism inventory: EP "absent");
 this module completes the framework's parallelism set (dp/sp/tp/pp/ep)
 the TPU-native way: experts are sharded over the ``expert`` axis (each
 device owns ``n_experts / |axis|`` expert FFNs), tokens are routed
-switch-style (top-1, capacity-bounded, load-balance aux loss), and each
+switch-style (top-1 default or GShard top-2 via ``topk=2``,
+capacity-bounded, load-balance aux loss), and each
 shard computes ONLY its local experts' tokens — partial outputs psum over
 the axis, so the engine's per-leaf sharded-param grad contract
 (train/step.py: sharded leaves 1/t, replicated pmean) applies unchanged.
@@ -85,13 +86,88 @@ def switch_route(
         probs = probs * valid[:, None].astype(jnp.float32)
     prob_e = probs.sum(axis=0)
     n_valid = count_e.sum() if valid is not None else jnp.float32(n)
+    aux = _balance_aux(count_e, prob_e, n_valid, stats_axes, e)
+    return assign, gate, slot, kept, aux
+
+
+def _balance_aux(count_e, prob_e, n_valid, stats_axes, e):
+    """Shazeer/Fedus load-balance aux from per-shard statistics, psum'd to
+    GLOBAL ratios over every token-sharding axis (the engine's global-loss
+    contract, train/step.py) — the single copy both routing fns share."""
     for ax in stats_axes:
         count_e = lax.psum(count_e, ax)
         prob_e = lax.psum(prob_e, ax)
         n_valid = lax.psum(n_valid, ax)
     n_valid = jnp.maximum(n_valid, 1.0)
-    aux = e * jnp.sum((count_e / n_valid) * (prob_e / n_valid))
+    return e * jnp.sum((count_e / n_valid) * (prob_e / n_valid))
+
+
+def switch_route_topk(
+    router_logits: jax.Array,
+    capacity: int,
+    k: int,
+    valid: jax.Array | None = None,
+    stats_axes: tuple[str, ...] = (),
+):
+    """Top-k routing (k=2 is the GShard default) with per-expert capacity.
+
+    Generalizes :func:`switch_route` (which stays the bit-exact top-1
+    path): each token picks its k highest-prob experts with gates
+    RENORMALIZED over the chosen k (g_j = p_j / sum_chosen p). Queue
+    priority is by choice rank — every token's FIRST choice occupies
+    expert queues before any second choice does (GShard's rule), then
+    token order within a rank; per-expert ``capacity`` is unchanged, so
+    top-2 doubles capacity pressure, which is the point of measuring it.
+    Dropped choices contribute 0 (no gate renormalization after drops).
+
+    Load-balance aux follows GShard: ``f_e`` counts FIRST choices only,
+    ``p_e`` is the mean softmax mass, aux = E * sum_e f_e * p_e.
+
+    Returns ``(assign [N,k], gate [N,k], slot [N,k], kept [N,k], aux)``.
+    """
+    n, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_p, assign = lax.top_k(probs, k)  # [N, k]
+    gate = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+    v = (
+        jnp.ones((n,), jnp.float32)
+        if valid is None
+        else valid.astype(jnp.float32)
+    )
+    onehot = jax.nn.one_hot(assign, e, dtype=jnp.float32) * v[:, None, None]
+    # Queue positions: rank-major priority. offset[j] = total tokens all
+    # earlier ranks placed in each expert's queue.
+    offset = jnp.zeros((e,), jnp.float32)
+    cols = []
+    for j in range(k):
+        oh = onehot[:, j, :]
+        within = (jnp.cumsum(oh, axis=0) * oh).sum(-1)  # 1-based, 0 if none
+        cols.append(within + (offset * oh).sum(-1) * (within > 0))
+        offset = offset + oh.sum(axis=0)
+    pos = jnp.stack(cols, axis=1)
+    kept = (pos > 0) & (pos <= capacity)
+    slot = (pos - 1).astype(jnp.int32)
+    count_e = onehot[:, 0, :].sum(axis=0)  # first choices only (GShard)
+    prob_e = (probs * v[:, None]).sum(axis=0)
+    aux = _balance_aux(count_e, prob_e, v.sum(), stats_axes, e)
     return assign, gate, slot, kept, aux
+
+
+def _route(router_logits, capacity, valid, stats_axes, topk):
+    """Unified [N, k]-shaped routing: top-1 keeps the bit-exact
+    :func:`switch_route` path (trajectory pins), top-k>=2 the GShard rules."""
+    if topk == 1:
+        assign, gate, slot, kept, aux = switch_route(
+            router_logits, capacity, valid, stats_axes
+        )
+        return (
+            assign[:, None],
+            gate[:, None],
+            slot[:, None],
+            kept[:, None],
+            aux,
+        )
+    return switch_route_topk(router_logits, capacity, topk, valid, stats_axes)
 
 
 def moe_apply(
@@ -104,8 +180,12 @@ def moe_apply(
     capacity_factor: float = 1.25,
     valid: jax.Array | None = None,
     stats_axes: tuple[str, ...] = (),
+    topk: int = 1,
 ):
-    """Apply a capacity-bounded top-1 MoE layer, experts sharded over
+    """Apply a capacity-bounded MoE layer (top-1 default; ``topk=2`` = the
+    GShard top-2 rules of :func:`switch_route_topk` — renormalized gates,
+    per-expert capacity UNCHANGED so top-2 doubles capacity pressure;
+    size ``capacity_factor`` accordingly), experts sharded over
     ``axis_name`` (tokens replicated across it; see :func:`moe_apply_a2a`
     for the token-sharded dispatch).
 
@@ -135,22 +215,27 @@ def moe_apply(
             f"router has {e_global} experts but shards hold {local_e} x {shards}"
         )
     capacity = int(-(-capacity_factor * n // e_global))  # ceil
-    assign, gate, slot, kept, aux = switch_route(
-        router_logits, capacity, valid, stats_axes
+    assign, gate, slot, kept, aux = _route(
+        router_logits, capacity, valid, stats_axes, topk
     )
+    # Flattened (token, choice) entries: rank j of token i is entry i*k + j.
+    # k=1 reduces to the original per-token arrays bit-for-bit.
+    fa, fg = assign.reshape(-1), gate.reshape(-1)
+    fs, fk = slot.reshape(-1), kept.reshape(-1)
+    tok = jnp.repeat(jnp.arange(n, dtype=jnp.int32), assign.shape[1])
     first_local = (0 if axis_name is None else lax.axis_index(axis_name)) * local_e
 
     def one_expert(params_e, e_idx):
-        mine = kept & (assign == e_idx)
-        # Gather this expert's tokens into its capacity buffer. Unfilled
+        mine = fk & (fa == e_idx)
+        # Gather this expert's entries into its capacity buffer. Unfilled
         # slots point at token 0 with weight 0 (w zeroes them out).
         token_idx = jnp.zeros((capacity,), jnp.int32)
-        token_idx = token_idx.at[jnp.where(mine, slot, capacity)].set(
-            jnp.arange(n, dtype=jnp.int32), mode="drop"
+        token_idx = token_idx.at[jnp.where(mine, fs, capacity)].set(
+            tok, mode="drop"
         )
         w = jnp.zeros((capacity,), x.dtype)
-        w = w.at[jnp.where(mine, slot, capacity)].set(
-            gate.astype(x.dtype), mode="drop"
+        w = w.at[jnp.where(mine, fs, capacity)].set(
+            fg.astype(x.dtype), mode="drop"
         )
         out_c = expert_fn(params_e, x[token_idx]) * w[:, None]
         # Scatter back to token positions.
@@ -182,6 +267,7 @@ def moe_apply_a2a(
     valid: jax.Array | None = None,
     stats_axes: tuple[str, ...] = (),
     tokens_sharded: bool = False,
+    topk: int = 1,
 ):
     """Token-sharded MoE dispatch: capacity-buffer all-to-all over the
     expert axis (the GShard/Switch production layout — VERDICT r2 Weak #4).
@@ -206,6 +292,9 @@ def moe_apply_a2a(
     ``stats_axes`` must include every axis tokens are sharded over
     (``axis_name`` at minimum, plus "seq" under sequence parallelism) so
     the load-balance aux is the global ratio on every shard.
+
+    ``topk`` selects the routing fan-out exactly as in :func:`moe_apply`
+    (2 = GShard top-2; per-expert capacity unchanged).
 
     ``tokens_sharded=True`` is the PRODUCTION layout (VERDICT r3 Missing
     #3): ``x``/``router_logits``/``valid`` are already this shard's slice
@@ -246,15 +335,17 @@ def moe_apply_a2a(
             else lax.dynamic_slice_in_dim(valid, start, n_loc, 0)
         )
     capacity = int(-(-capacity_factor * n_loc // e_global))  # ceil, per group
-    assign, gate, slot, kept, aux = switch_route(
-        logits_loc, capacity, valid_loc, stats_axes
+    assign, gate, slot, kept, aux = _route(
+        logits_loc, capacity, valid_loc, stats_axes, topk
     )
 
-    # Scatter my kept tokens into per-(global expert) capacity buffers.
-    idx_e = jnp.where(kept, assign, e_global)  # overflow -> OOB, dropped
-    idx_c = jnp.where(kept, slot, 0)
+    # Scatter my kept (token, choice) entries into per-(global expert)
+    # capacity buffers (k=1 reduces to the original per-token scatter).
+    tokf = jnp.repeat(jnp.arange(n_loc, dtype=jnp.int32), assign.shape[1])
+    idx_e = jnp.where(kept, assign, e_global).reshape(-1)  # overflow -> OOB
+    idx_c = jnp.where(kept, slot, 0).reshape(-1)
     disp = jnp.zeros((e_global, capacity, h), x.dtype)
-    disp = disp.at[idx_e, idx_c].set(x_loc, mode="drop")
+    disp = disp.at[idx_e, idx_c].set(x_loc[tokf], mode="drop")
 
     # A2A #1: block j of my buffers -> shard j. Received rows are ordered by
     # source shard: recv[j*local_e + k] = source j's buffer for my expert k.
@@ -281,8 +372,9 @@ def moe_apply_a2a(
     )
     ret = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0, tiled=True)
 
-    y_loc = ret[jnp.where(kept, assign, 0), jnp.where(kept, slot, 0)]
-    y_loc = y_loc * (gate * kept).astype(x.dtype)[:, None]
+    # Per-choice output gather, gate-weighted and summed over the k choices.
+    vals = ret[jnp.where(kept, assign, 0), jnp.where(kept, slot, 0)]  # [N,k,H]
+    y_loc = (vals * (gate * kept).astype(x.dtype)[..., None]).sum(axis=1)
     if tokens_sharded:
         # Token-sharded contract: the caller's batch is sharded over the
         # expert axis, so the local outputs ARE the layer's outputs.

@@ -429,3 +429,43 @@ def test_moe_with_seq_parallel_trains(devices8):
     assert np.isfinite(float(metrics["loss"]))
     assert float(metrics["moe_aux"]) > 0
     assert int(state.step) == 2
+
+
+def test_moe_topk2_sharded_bert_trains(devices8):
+    """GShard top-2 routing (r5, --moe-topk=2) through the production
+    token-sharded dispatch: compiles, trains, finite loss, positive aux;
+    dense-FFN leaves stay bit-identical across expert shards via the
+    engine contract (same harness as the top-1 sharded test)."""
+    cfg_init = BertConfig(**TINY_MOE)
+    params = _init_global(cfg_init)
+    cfg = dataclasses.replace(
+        cfg_init,
+        expert_axis="expert",
+        expert_parallel=4,
+        moe_dispatch="sharded",
+        moe_topk=2,
+    )
+    mesh = build_mesh({"data": 2, "expert": 4})
+    tx = optax.adam(1e-3)
+    specs = make_state_specs(
+        create_train_state(params, tx),
+        tx,
+        bert_param_specs(params, model_axis=None, expert_axis="expert"),
+    )
+    state = place_state(create_train_state(params, tx), mesh, specs)
+    step = make_train_step(
+        make_bert_pretraining_loss(BertForPreTraining(cfg)),
+        tx,
+        mesh,
+        batch_spec=bert_batch_specs(mesh, expert_sharded=True),
+        state_specs=specs,
+    )
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=96, seq_len=L, seed=0))
+    batches = mlm_device_batches(data, mesh, 16, expert_sharded=True, seed=3)
+    loss = aux = None
+    for _ in range(3):
+        state, m = step(state, next(batches), jax.random.key(1))
+        loss, aux = float(m["loss"]), float(m["moe_aux"])
+    assert np.isfinite(loss)
+    assert aux > 0
+    assert int(state.step) == 3

@@ -124,6 +124,7 @@ def test_cli_bert_tiny_moe_and_eval(tmp_path):
             "--bert-vocab=256",
             "--moe-experts=4",
             "--expert-parallel=2",
+            "--moe-topk=2",
             "--log-every=1",
             "--eval-every=2",
             "--eval-batches=1",

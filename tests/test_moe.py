@@ -219,3 +219,80 @@ def test_moe_training_matches_single_shard(devices8):
             atol=1e-5,
             err_msg=jax.tree_util.keystr(path),
         )
+
+
+def test_switch_route_topk_semantics():
+    """Top-2 routing (r5): renormalized gates, first-choice queue priority,
+    per-expert capacity unchanged."""
+    from distributed_tensorflow_tpu.parallel.moe import switch_route_topk
+
+    logits = jnp.array(
+        [
+            [4.0, 3.0, 0.0],   # t0: e0 then e1
+            [4.0, 3.0, 0.0],   # t1: e0 then e1
+            [3.0, 4.0, 0.0],   # t2: e1 then e0
+            [0.0, 0.0, 5.0],   # t3: e2 then (e0 or e1, tie -> lower idx e0)
+        ]
+    )
+    assign, gate, slot, kept, aux = switch_route_topk(logits, capacity=2, k=2)
+    assert assign.shape == (4, 2)
+    np.testing.assert_array_equal(np.asarray(assign[:, 0]), [0, 0, 1, 2])
+    # Gates renormalize over the chosen pair: sum to 1 per token.
+    np.testing.assert_allclose(np.asarray(gate.sum(-1)), np.ones(4), rtol=1e-6)
+    # First choices fill queues before ANY second choice: e0's queue is
+    # [t0#1, t1#1] (capacity 2) -> t2's and t3's SECOND choices of e0 are
+    # dropped; t0/t1's second choices land in e1's queue behind t2's first.
+    kept = np.asarray(kept)
+    assert kept[0, 0] and kept[1, 0] and kept[2, 0] and kept[3, 0]
+    assert not kept[2, 1] and not kept[3, 1]  # e0 full from first choices
+    assert kept[0, 1] and not kept[1, 1]      # e1: t2#1, then t0#2; t1#2 over
+    assert float(aux) > 0
+
+
+def test_moe_apply_topk2_matches_single_shard(devices8):
+    """Top-2 dispatch equality across layouts (mirrors the top-1 set):
+    sharded-expert moe_apply == single-shard reference, and the a2a layout
+    matches too when capacity is ample (grouped quotas never bind)."""
+    from distributed_tensorflow_tpu.parallel.moe import moe_apply_a2a
+
+    params = _init_params(jax.random.key(0))
+    stacked = params["experts"]
+    rng = np.random.default_rng(1)
+    n = 64
+    x = jnp.asarray(rng.normal(size=(n, H)), jnp.float32)
+    logits = jnp.asarray(rng.normal(size=(n, E)), jnp.float32)
+
+    y_ref, aux_ref = moe_apply(
+        _expert_fn, stacked, logits, x, axis_name=None,
+        capacity_factor=16.0, topk=2,
+    )
+    assert float(jnp.abs(y_ref).sum()) > 0
+
+    mesh = build_mesh({"expert": 8})
+    specs = expert_param_specs(stacked)
+
+    def run(fn, **kw):
+        f = jax.jit(
+            jax.shard_map(
+                lambda s, l, xx: fn(
+                    _expert_fn, s, l, xx, axis_name="expert",
+                    capacity_factor=16.0, topk=2, **kw,
+                ),
+                mesh=mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        return f(stacked, logits, x)
+
+    y_ep, aux_ep = run(moe_apply)
+    np.testing.assert_allclose(
+        np.asarray(y_ep), np.asarray(y_ref), atol=1e-5
+    )
+    np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-6)
+
+    y_a2a, _ = run(moe_apply_a2a, stats_axes=("expert",))
+    np.testing.assert_allclose(
+        np.asarray(y_a2a), np.asarray(y_ref), atol=1e-5
+    )
