@@ -326,6 +326,11 @@ def _build_bert_workload(cfg_kwargs: dict):
                         f"--moe-experts={cfg.moe_experts} not divisible by "
                         f"--expert-parallel={ep}"
                     )
+                if not 1 <= cfg.moe_topk <= cfg.moe_experts:
+                    raise ValueError(
+                        f"--moe-topk={cfg.moe_topk} must be in "
+                        f"[1, --moe-experts={cfg.moe_experts}]"
+                    )
                 # Init with the GLOBAL expert count (expert_parallel=1) and
                 # the replicated dispatch — "sharded" needs a bound expert
                 # axis and an expert-sharded batch, neither of which exists
@@ -857,6 +862,8 @@ def main(argv: list[str] | None = None):
         overrides["moe_experts"] = args.moe_experts
     if args.moe_dispatch:
         overrides["moe_dispatch"] = args.moe_dispatch
+    if args.moe_topk == 0:
+        raise SystemExit("--moe-topk must be >= 1")
     if args.moe_topk > 0:
         overrides["moe_topk"] = args.moe_topk
     if args.expert_parallel >= 0:

@@ -135,9 +135,11 @@ def bench_config(
 
 def main():
     results = [
-        bench_config(128, 128, attn_impl="auto"),  # auto -> dense at 128
-        bench_config(512, 48, attn_impl="auto"),   # auto -> flash at 512;
-        # b=48 won the r4 sweep (same config driver_line reports)
+        bench_config(128, 64, attn_impl="auto"),   # auto -> dense at 128
+        bench_config(512, 32, attn_impl="auto"),   # auto -> flash at 512
+        # b=64 / b=32 won the r5 re-sweep under the new recipe (the r4
+        # knees, 128 / 48, moved down once the per-step overhead fell —
+        # docs/PERF.md r5 re-sweep table; same configs driver_line reports)
     ]
     for r in results:
         print(json.dumps(r))
@@ -146,11 +148,14 @@ def main():
 
 def driver_line():
     """One-line JSON for the driver protocol (bench.py's r5 default)."""
-    # b=48/chip won the r4 L=512 batch sweep (mfu 0.331 @ 24, 0.360 @ 48,
-    # 0.353 @ 64, 0.324 @ 96 — docs/PERF.md r4); the r5 campaign lifted the
-    # same config via rbg dropout rng, bf16-logit CE, tanh gelu, 512/512
-    # flash blocks, exp2 softmax (docs/PERF.md r5 bucket tables).
-    r = bench_config(512, 48, attn_impl="auto")  # auto -> flash at L=512
+    # b=32/chip won the r5 L=512 re-sweep under the new recipe (mfu
+    # 0.549 @ 16, 0.559 @ 24, 0.556 @ 32, 0.521 @ 48, 0.531 @ 64,
+    # 0.472 @ 96 — b=24 ties b=32 inside its 1.3% spread; b=32's spread
+    # is 0.2%, so it is the reported config). The r4 knee was b=48; it
+    # moved once the campaign removed ~75 ms/step of overhead (rbg
+    # dropout rng, bf16-logit CE, tanh gelu, 512/512 exp2 flash —
+    # docs/PERF.md r5 bucket tables).
+    r = bench_config(512, 32, attn_impl="auto")  # auto -> flash at L=512
     dev = jax.devices()[0]
     print(
         json.dumps(

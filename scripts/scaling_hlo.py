@@ -131,7 +131,17 @@ def main():
         import json as _json
 
         measured = _json.loads(results[0][4:])
-        ring_model(measured["bytes"].get("all-reduce", 102.43e6))
+        # Sum ALL reduction kinds: if XLA ever lowers the gradient sync as
+        # reduce-scatter + all-gather instead of one all-reduce, the model
+        # still sees the real bytes (and fails loudly on zero rather than
+        # silently predicting from a stale constant).
+        grad_bytes = sum(
+            v for k, v in measured["bytes"].items()
+            if k in ("all-reduce", "reduce-scatter", "all-gather")
+        )
+        if not grad_bytes:
+            raise SystemExit("no gradient-reduction collectives parsed")
+        ring_model(grad_bytes)
     else:
         print("FAIL: per-device cost drifts with mesh size:", flush=True)
         for r in results:
