@@ -2,11 +2,12 @@
 
 Default workload (r5, VERDICT r4 Weak #3): BERT-base pretraining at L=512
 — the transformer config is the axis where the measured chip ceiling is
-actually approachable (docs/PERF.md r5: MFU 0.360 -> ~0.52 this round),
-where the conv workloads sit at a measured structural ~0.17 plateau
-(docs/PERF.md r3/r4 CASE CLOSED). ``BENCH_WORKLOAD=resnet50`` selects the
-unchanged ResNet-50 line (rounds 1-4's default); ``BENCH_WORKLOAD=bert``
-still works and equals the default.
+actually approachable (docs/PERF.md r5: MFU 0.360 -> 0.600 this round,
+recipe campaign + layout-native packed flash kernels), where the conv
+workloads sit at a measured structural ~0.17 plateau (docs/PERF.md r3/r4
+CASE CLOSED). ``BENCH_WORKLOAD=resnet50`` selects the unchanged ResNet-50
+line (rounds 1-4's default); ``BENCH_WORKLOAD=bert`` still works and
+equals the default.
 
 Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
 ``vs_baseline`` is measured MFU / 0.55 — the reference repo publishes no
@@ -136,9 +137,9 @@ def main():
     # The r4 kernel campaign (docs/PERF.md "CASE CLOSED") measured seven
     # custom-kernel configurations, all losing to XLA's in-context codegen:
     # ~0.17 is the practical max for this conv+BN model on this chip. The
-    # same engine reaches 0.42 MFU on matmul-dominated BERT (bench_bert.py;
-    # its L=512 number rides in this unit string so the driver captures
-    # the transformer context too — VERDICT r3 Weak #5).
+    # same engine reaches 0.60 MFU on matmul-dominated BERT at L=512
+    # (bench_bert.py, r5 packed-flash config) — which is why the driver
+    # default workload is the transformer since r5.
     ceil_note = (
         "meas-roofline-ceiling~0.30, practical-max~0.17 per docs/PERF.md r4 "
         "kernel study; driver default is the transformer workload since r5"

@@ -18,6 +18,18 @@ Kernel structure (one (batch, head, q-block) program per grid point):
   fwd:  stream K/V blocks from VMEM, online softmax, save per-row logsumexp
   bwd:  dQ pass gridded over q-blocks; dK/dV pass gridded over k-blocks;
         both recompute P from the saved logsumexp (no [L,L] residual)
+
+Two kernel families share that structure (``packing=`` selects; None=auto):
+  "bh"   — operands transposed to [B*H, L, D] in HBM (4 relayouts per
+           layer-direction, ~200 GB/s copies; measured 11.9 ms/step at the
+           L=512 b=32 BERT config before r5).
+  "flat" — r5: operands stay FLAT [B, L, H*D] (the layout the surrounding
+           projections produce/consume — zero HBM relayouts); the kernel
+           isolates heads by lane-masking aligned 128-lane tiles, which
+           costs no extra MXU passes. Measured at BERT-base production
+           geometry (b=32, L=512): fwd 0.862 -> 0.648 ms, fwd+bwd
+           2.395 -> 1.780 ms per layer vs "bh". See the packed-section
+           comment below for the masking identity and its constraints.
 """
 
 from __future__ import annotations
@@ -342,6 +354,424 @@ def _flash_block_bwd(block_q, block_k, interpret, residuals, g):
 _flash_block.defvjp(_flash_block_fwd, _flash_block_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Packed (layout-native) kernels — r5
+# ---------------------------------------------------------------------------
+#
+# The bh-major kernels above require [B*H, L, D], which costs four HBM
+# relayouts per layer-direction ([B,L,H,D] <-> [B,H,L,D] for q/k/v in and
+# o out, again in backward) — measured 11.9 ms/step at the shipped L=512
+# b=32 BERT config, ~200 GB/s copies the bucket table files under "other"
+# (docs/PERF.md r5). A head-minor BlockSpec ((1, bq, H, D)) was built and
+# rejected: (H=12, D=64) minor dims violate the (8,128) tile rule and
+# Mosaic pads 12->16 x 64->128 on every operand.
+#
+# This variant threads the needle: operands stay FLAT [B, L, H*D] — the
+# exact layout the surrounding projections produce and consume, and
+# (8,128)-clean since H*D = 768. Heads are separated WITHOUT lane slicing
+# (Mosaic also rejects sub-128 lane-offset loads: "cannot statically prove
+# that index in dimension 2 is a multiple of 128" — measured this round):
+# the kernel loads aligned 128-lane tiles holding 128/D heads each and
+# isolates head h by LANE MASKING the q (resp. do/ds) operand before the
+# matmul. Because MXU contraction and output tiles are 128 wide, a masked
+# 128-wide matmul costs exactly the same systolic passes as the bh
+# kernels' 64-wide one — the mask just zeroes the cross-head terms:
+#   (q * mask_h) @ k_tile^T == q_h @ k_h^T            (contraction side)
+#   (p_h @ v_tile) * mask_h == p_h @ v_h  in h's lanes (output side)
+# so the per-head math is exactly the bh kernels'; only the addressing
+# changed. VMEM per program: q/k/v/o blocks at bq = bk = L = 512 total
+# ~4 MB of the ~16 MB budget. The lse contract also improves: the kernel
+# writes [B, H, L] natural-log lse directly (what the ring merge wants).
+
+
+def _lane_masks(d: int, dtype):
+    """Per-head lane masks for one 128-lane tile holding 128//d heads."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    return [
+        ((lane >= e * d) & (lane < (e + 1) * d)).astype(dtype)
+        for e in range(128 // d)
+    ]
+
+
+def _fwd_kernel_packed(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, block_k, scale, heads
+):
+    # q_ref: [BQ, HD]; k_ref/v_ref: [L, HD]; mask_ref: [1, L];
+    # o_ref: [BQ, HD]; lse_ref: FULL [H, L] (each program writes its
+    # q-range — an L-sized lane slice per q-block would break the
+    # 128-lane rule for small blocks). One program per (batch, q-block).
+    bq, hd = q_ref.shape
+    l = k_ref.shape[0]
+    d = hd // heads
+    hpt = 128 // d  # heads per 128-lane tile
+    qi = pl.program_id(1)
+    for t in range(hd // 128):
+        q_t = q_ref[:, 128 * t : 128 * (t + 1)]
+        msks = _lane_masks(d, q_t.dtype)
+        q_heads = [q_t * msks[e] for e in range(hpt)]
+
+        def body(j, carry, t=t, q_heads=q_heads):
+            k_t = k_ref[pl.ds(j * block_k, block_k), 128 * t : 128 * (t + 1)]
+            v_t = v_ref[pl.ds(j * block_k, block_k), 128 * t : 128 * (t + 1)]
+            mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
+            out = []
+            for e in range(hpt):
+                o, m, denom = carry[e]
+                # Contraction over all 128 lanes of the masked q is
+                # exactly q_h @ k_h^T: the mask zeroes other heads' terms.
+                s = (scale * _LOG2E) * jax.lax.dot_general(
+                    q_heads[e], k_t, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                s = jnp.where(mask_blk[None, :] != 0, s, _NEG)
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+                p = jnp.exp2(s - m_new[:, None])
+                p = p * mask_blk[None, :]
+                corr = jnp.exp2(m - m_new)
+                denom = denom * corr + jnp.sum(p, axis=-1)
+                # p @ v_tile: head h's lanes carry p_h @ v_h; other heads'
+                # lanes carry garbage that the write-combine masks off.
+                o = o * corr[:, None] + jax.lax.dot_general(
+                    p.astype(v_t.dtype),
+                    v_t,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                out.append((o, m_new, denom))
+            return tuple(out)
+
+        init = tuple(
+            (
+                jnp.zeros((bq, 128), jnp.float32),
+                jnp.full((bq,), _NEG, jnp.float32),
+                jnp.zeros((bq,), jnp.float32),
+            )
+            for _ in range(hpt)
+        )
+        carry = jax.lax.fori_loop(0, l // block_k, body, init)
+        o_tile = jnp.zeros((bq, 128), jnp.float32)
+        for e in range(hpt):
+            o, m, denom = carry[e]
+            safe = jnp.maximum(denom, 1e-37)
+            o_tile = o_tile + (o / safe[:, None]) * msks[e].astype(jnp.float32)
+            lse_ref[t * hpt + e, pl.ds(qi * bq, bq)] = jnp.where(
+                denom > 0, m / _LOG2E + jnp.log(safe), _NEG
+            )
+        o_ref[:, 128 * t : 128 * (t + 1)] = o_tile.astype(o_ref.dtype)
+
+
+def _fwd_packed(q, k, v, mask, heads, block_q, block_k, interpret):
+    b, l, hd = q.shape
+    scale = (hd // heads) ** -0.5
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel_packed, block_k=block_k, scale=scale, heads=heads
+        ),
+        grid=(b, l // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, l, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, l, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, heads, l), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, l, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, heads, l), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask)
+    return o, lse
+
+
+def _bwd_dq_kernel_packed(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k, scale, heads,
+):
+    # q/do/dq: [BQ, HD]; k/v: [L, HD]; mask: [1, L]; lse/delta: FULL [H, L]
+    # (sliced per program — see _fwd_kernel_packed).
+    bq, hd = q_ref.shape
+    l = k_ref.shape[0]
+    d = hd // heads
+    hpt = 128 // d
+    qi = pl.program_id(1)
+    for t in range(hd // 128):
+        q_t = q_ref[:, 128 * t : 128 * (t + 1)]
+        do_t = do_ref[:, 128 * t : 128 * (t + 1)]
+        msks = _lane_masks(d, q_t.dtype)
+        q_heads = [q_t * msks[e] for e in range(hpt)]
+        do_heads = [do_t * msks[e] for e in range(hpt)]
+        lses = [
+            lse_ref[t * hpt + e, pl.ds(qi * bq, bq)] for e in range(hpt)
+        ]
+        deltas = [
+            delta_ref[t * hpt + e, pl.ds(qi * bq, bq)] for e in range(hpt)
+        ]
+
+        def body(j, dqs, t=t, q_heads=q_heads, do_heads=do_heads,
+                 lses=lses, deltas=deltas):
+            k_t = k_ref[pl.ds(j * block_k, block_k), 128 * t : 128 * (t + 1)]
+            v_t = v_ref[pl.ds(j * block_k, block_k), 128 * t : 128 * (t + 1)]
+            mask_blk = mask_ref[0, pl.ds(j * block_k, block_k)]
+            out = []
+            for e in range(hpt):
+                s = (scale * _LOG2E) * jax.lax.dot_general(
+                    q_heads[e], k_t, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                # Scaled-domain mask value — see _bwd_dq_kernel.
+                s = jnp.where(mask_blk[None, :] != 0, s, _NEG * _LOG2E)
+                p = (
+                    jnp.exp2(s - (_LOG2E * lses[e])[:, None])
+                    * mask_blk[None, :]
+                )
+                dp = jax.lax.dot_general(
+                    do_heads[e], v_t, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                ds = p * (dp - deltas[e][:, None])
+                # ds @ k_tile: head h's lanes carry ds_h @ k_h; the
+                # write-combine below masks the rest.
+                out.append(
+                    dqs[e]
+                    + jax.lax.dot_general(
+                        ds.astype(k_t.dtype),
+                        k_t,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            return tuple(out)
+
+        init = tuple(jnp.zeros((bq, 128), jnp.float32) for _ in range(hpt))
+        dqs = jax.lax.fori_loop(0, l // block_k, body, init)
+        dq_tile = jnp.zeros((bq, 128), jnp.float32)
+        for e in range(hpt):
+            dq_tile = dq_tile + dqs[e] * msks[e].astype(jnp.float32)
+        dq_ref[:, 128 * t : 128 * (t + 1)] = (dq_tile * scale).astype(
+            dq_ref.dtype
+        )
+
+
+def _bwd_dkv_kernel_packed(
+    q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, scale, heads,
+):
+    # k/v/dk/dv: [BK, HD]; q/do: [L, HD]; mask/lse/delta: FULL [1|H, L].
+    bk, hd = k_ref.shape
+    l = q_ref.shape[0]
+    d = hd // heads
+    hpt = 128 // d
+    kj = pl.program_id(1)
+    mask_blk = mask_ref[0, pl.ds(kj * bk, bk)]
+    for t in range(hd // 128):
+        k_t = k_ref[:, 128 * t : 128 * (t + 1)]
+        v_t = v_ref[:, 128 * t : 128 * (t + 1)]
+        msks = _lane_masks(d, k_t.dtype)
+
+        def body(i, carry, t=t, k_t=k_t, v_t=v_t, msks=msks):
+            q_blk = q_ref[pl.ds(i * block_q, block_q), 128 * t : 128 * (t + 1)]
+            do_blk = do_ref[
+                pl.ds(i * block_q, block_q), 128 * t : 128 * (t + 1)
+            ]
+            out = []
+            for e in range(hpt):
+                dk, dv = carry[e]
+                lse_blk = lse_ref[t * hpt + e, pl.ds(i * block_q, block_q)]
+                delta_blk = delta_ref[
+                    t * hpt + e, pl.ds(i * block_q, block_q)
+                ]
+                q_h = q_blk * msks[e]
+                s = (scale * _LOG2E) * jax.lax.dot_general(
+                    q_h, k_t, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                s = jnp.where(mask_blk[None, :] != 0, s, _NEG * _LOG2E)
+                p = (
+                    jnp.exp2(s - (_LOG2E * lse_blk)[:, None])
+                    * mask_blk[None, :]
+                )
+                p_lo = p.astype(do_blk.dtype)
+                # p^T @ do_tile: head h's lanes carry p_h^T @ do_h
+                # (garbage elsewhere, masked in the write-combine).
+                dv = dv + jax.lax.dot_general(
+                    p_lo, do_blk, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                dp = jax.lax.dot_general(
+                    do_blk * msks[e], v_t, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                ds = p * (dp - delta_blk[:, None])
+                dk = dk + jax.lax.dot_general(
+                    ds.astype(q_blk.dtype),
+                    q_blk,
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                out.append((dk, dv))
+            return tuple(out)
+
+        init = tuple(
+            (
+                jnp.zeros((bk, 128), jnp.float32),
+                jnp.zeros((bk, 128), jnp.float32),
+            )
+            for _ in range(hpt)
+        )
+        carry = jax.lax.fori_loop(0, l // block_q, body, init)
+        dk_tile = jnp.zeros((bk, 128), jnp.float32)
+        dv_tile = jnp.zeros((bk, 128), jnp.float32)
+        for e in range(hpt):
+            dk, dv = carry[e]
+            f32m = msks[e].astype(jnp.float32)
+            dk_tile = dk_tile + dk * f32m
+            dv_tile = dv_tile + dv * f32m
+        dk_ref[:, 128 * t : 128 * (t + 1)] = (dk_tile * scale).astype(
+            dk_ref.dtype
+        )
+        dv_ref[:, 128 * t : 128 * (t + 1)] = dv_tile.astype(dv_ref.dtype)
+
+
+def _bwd_impl_packed(heads, block_q, block_k, interpret, residuals, do, dlse=None):
+    """Packed backward. ``dlse`` folds into delta exactly as in _bwd_impl."""
+    q, k, v, mask, o, lse = residuals  # lse: [B, H, L]
+    b, l, hd = q.shape
+    d = hd // heads
+    scale = d**-0.5
+    # Per-head delta_i = sum_d do*o — [B, L, H] reduce, then head-major.
+    delta = (
+        (do.astype(jnp.float32) * o.astype(jnp.float32))
+        .reshape(b, l, heads, d)
+        .sum(axis=-1)
+        .transpose(0, 2, 1)
+    )  # [B, H, L] — small (B*H*L f32), the transpose is noise next to qkv
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel_packed, block_k=block_k, scale=scale, heads=heads
+        ),
+        grid=(b, l // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, l, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, l, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, heads, l), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, heads, l), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, hd), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, l, hd), q.dtype),
+        interpret=interpret,
+    )(q, k, v, mask, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel_packed, block_q=block_q, scale=scale, heads=heads
+        ),
+        grid=(b, l // block_k),
+        in_specs=[
+            pl.BlockSpec((None, l, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, 1, l), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, l, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, heads, l), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, heads, l), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, hd), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, l, hd), k.dtype),
+            jax.ShapeDtypeStruct((b, l, hd), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask, do, lse, delta)
+    return dq, dk, dv, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_packed(q, k, v, mask, heads, block_q, block_k, interpret):
+    o, _ = _fwd_packed(q, k, v, mask, heads, block_q, block_k, interpret)
+    return o
+
+
+def _flash_packed_fwd(q, k, v, mask, heads, block_q, block_k, interpret):
+    o, lse = _fwd_packed(q, k, v, mask, heads, block_q, block_k, interpret)
+    return o, (q, k, v, mask, o, lse)
+
+
+def _flash_packed_bwd(heads, block_q, block_k, interpret, residuals, g):
+    return _bwd_impl_packed(heads, block_q, block_k, interpret, residuals, g)
+
+
+_flash_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_block_packed(q, k, v, mask, heads, block_q, block_k, interpret):
+    return _fwd_packed(q, k, v, mask, heads, block_q, block_k, interpret)
+
+
+def _flash_block_packed_fwd(q, k, v, mask, heads, block_q, block_k, interpret):
+    o, lse = _fwd_packed(q, k, v, mask, heads, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, mask, o, lse)
+
+
+def _flash_block_packed_bwd(heads, block_q, block_k, interpret, residuals, g):
+    do, dlse = g
+    return _bwd_impl_packed(
+        heads, block_q, block_k, interpret, residuals, do, dlse
+    )
+
+
+_flash_block_packed.defvjp(_flash_block_packed_fwd, _flash_block_packed_bwd)
+
+
+def _packing_ok(h: int, d: int) -> bool:
+    """Packed-path geometry: whole heads must tile 128-lane groups — D a
+    divisor of 128 (64 for BERT-base: two heads per tile) and H*D a
+    multiple of 128. Covers tp shards with an even local head count
+    (12, 6, 4, 2 heads at D=64); odd shards (tp=4 -> 3 heads, 192 lanes)
+    fall back to the bh kernels."""
+    return d <= 128 and 128 % d == 0 and (h * d) % 128 == 0
+
+
+def _flat_auto(h, d, block_q, block_k, interpret) -> bool:
+    # Compiled-mode lane slices (lse/delta/mask at block offsets) need
+    # 128-aligned blocks; interpret mode has no such constraint.
+    if not _packing_ok(h, d):
+        return False
+    return interpret or (block_q % 128 == 0 and block_k % 128 == 0)
+
+
+def _require_flat(h, d, block_q, block_k, interpret) -> None:
+    """Loud guard for EXPLICIT packing="flat": an unsupported geometry must
+    not reach the kernels — the head loop covers only hd//128 lane tiles, so
+    e.g. H*D=192 leaves lanes 128-191 unread and returns garbage (silently
+    in interpret mode; as an opaque Mosaic internal error compiled)."""
+    if not _packing_ok(h, d):
+        raise ValueError(
+            f"packing='flat' needs whole heads tiling 128-lane groups "
+            f"(D | 128 and H*D % 128 == 0); got H={h}, D={d}. "
+            "Use packing='bh' or None (auto)."
+        )
+    if not interpret and (block_q % 128 or block_k % 128):
+        raise ValueError(
+            f"packing='flat' compiled for TPU needs 128-aligned blocks "
+            f"(lane-slice rule); got block_q={block_q}, block_k={block_k}. "
+            "Use packing='bh' or None (auto)."
+        )
+
+
 def flash_attention_block(
     q,
     k,
@@ -351,6 +781,7 @@ def flash_attention_block(
     block_q: int = _DEFAULT_BLOCK_Q,
     block_k: int = _DEFAULT_BLOCK_K,
     interpret: bool | None = None,
+    packing: str | None = None,
 ):
     """One flash block with its logsumexp: the ring's inner step.
 
@@ -360,6 +791,10 @@ def flash_attention_block(
     per-row logsumexp, which parallel/ring_attention.py uses to merge blocks
     exactly (numerically stable weighted combine). Differentiable in both
     outputs (the lse cotangent rides the same backward kernels).
+
+    ``packing``: ``"flat"`` (layout-native packed kernels, the r5 default
+    where head geometry allows — see module comment), ``"bh"`` (the
+    transpose-into-[B*H, L, D] kernels), or None for the auto rule.
     """
     if interpret is None:
         interpret = _use_interpret()
@@ -370,6 +805,24 @@ def flash_attention_block(
     block_k = _fit_block(block_k, l)
     if mask is None:
         mask = jnp.ones((b, l), bool)
+    if packing is None:
+        packing = "flat" if _flat_auto(h, d, block_q, block_k, interpret) else "bh"
+    elif packing == "flat":
+        _require_flat(h, d, block_q, block_k, interpret)
+
+    if packing == "flat":
+        mask_f = mask.astype(jnp.float32).reshape(b, 1, l)
+        o, lse = _flash_block_packed(
+            q.reshape(b, l, h * d),
+            k.reshape(b, l, h * d),
+            v.reshape(b, l, h * d),
+            mask_f,
+            h,
+            block_q,
+            block_k,
+            interpret,
+        )
+        return o.reshape(b, l, h, d), lse
 
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
@@ -391,12 +844,15 @@ def flash_attention(
     block_q: int = _DEFAULT_BLOCK_Q,
     block_k: int = _DEFAULT_BLOCK_K,
     interpret: bool | None = None,
+    packing: str | None = None,
 ):
     """Exact attention, flash-style. Layout ``[B, L, H, D]``, mask ``[B, L]``.
 
     Pads L up to a block multiple internally (padded keys masked out, padded
     query rows sliced off). ``interpret=None`` auto-selects interpreter mode
-    off-TPU so tests run on CPU.
+    off-TPU so tests run on CPU. ``packing`` as in
+    :func:`flash_attention_block` (None = auto: layout-native packed kernels
+    when the head geometry is lane-aligned, else the bh-major kernels).
     """
     if interpret is None:
         interpret = _use_interpret()
@@ -415,6 +871,24 @@ def flash_attention(
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
         mask = jnp.pad(mask, ((0, 0), (0, l_pad - l)))
+    if packing is None:
+        packing = "flat" if _flat_auto(h, d, block_q, block_k, interpret) else "bh"
+    elif packing == "flat":
+        _require_flat(h, d, block_q, block_k, interpret)
+
+    if packing == "flat":
+        mask_f = mask.astype(jnp.float32).reshape(b, 1, l_pad)
+        o = _flash_packed(
+            q.reshape(b, l_pad, h * d),
+            k.reshape(b, l_pad, h * d),
+            v.reshape(b, l_pad, h * d),
+            mask_f,
+            h,
+            block_q,
+            block_k,
+            interpret,
+        )
+        return o.reshape(b, l_pad, h, d)[:, :l]
 
     # [B, L, H, D] -> [B*H, L, D]
     def to_bh(x):

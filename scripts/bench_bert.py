@@ -136,10 +136,11 @@ def bench_config(
 def main():
     results = [
         bench_config(128, 64, attn_impl="auto"),   # auto -> dense at 128
-        bench_config(512, 32, attn_impl="auto"),   # auto -> flash at 512
-        # b=64 / b=32 won the r5 re-sweep under the new recipe (the r4
-        # knees, 128 / 48, moved down once the per-step overhead fell —
-        # docs/PERF.md r5 re-sweep table; same configs driver_line reports)
+        bench_config(512, 24, attn_impl="auto"),   # auto -> flash at 512
+        # b=64 / b=24 won the latest re-sweeps (knees MOVE when step
+        # overhead falls: r4 b=128/48 -> recipe campaign b=64/32 ->
+        # packed flash kernels b=64/24 — docs/PERF.md r5 tables; same
+        # configs driver_line reports)
     ]
     for r in results:
         print(json.dumps(r))
@@ -148,14 +149,13 @@ def main():
 
 def driver_line():
     """One-line JSON for the driver protocol (bench.py's r5 default)."""
-    # b=32/chip won the r5 L=512 re-sweep under the new recipe (mfu
-    # 0.549 @ 16, 0.559 @ 24, 0.556 @ 32, 0.521 @ 48, 0.531 @ 64,
-    # 0.472 @ 96 — b=24 ties b=32 inside its 1.3% spread; b=32's spread
-    # is 0.2%, so it is the reported config). The r4 knee was b=48; it
-    # moved once the campaign removed ~75 ms/step of overhead (rbg
-    # dropout rng, bf16-logit CE, tanh gelu, 512/512 exp2 flash —
-    # docs/PERF.md r5 bucket tables).
-    r = bench_config(512, 32, attn_impl="auto")  # auto -> flash at L=512
+    # b=24/chip won the sweep under the r5 PACKED flash kernels (mfu
+    # 0.586 @ 16, 0.600 @ 24, 0.585 @ 32, 0.564 @ 48; b=24 re-measured
+    # at 0.6003 with 0.05% spread over 60-step windows). Knee history —
+    # it moves every time the step gets leaner: r4 recipe b=48 ->
+    # recipe campaign b=32 -> layout-native flash b=24 (docs/PERF.md r5
+    # bucket tables and the packed-kernel section).
+    r = bench_config(512, 24, attn_impl="auto")  # auto -> flash at L=512
     dev = jax.devices()[0]
     print(
         json.dumps(

@@ -82,3 +82,120 @@ def test_flash_mismatched_blocks_pad_to_lcm():
     ref = dense_attention(q, k, v)
     out = flash_attention(q, k, v, block_q=16, block_k=24)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# --- packed (layout-native) kernels: r5 ------------------------------------
+# Geometry must tile 128-lane groups (D | 128, H*D % 128 == 0) — (4, 32)
+# puts 4 heads in one tile, (2, 64) is the BERT-base head pair shape.
+
+
+@pytest.mark.parametrize("h,d", [(4, 32), (2, 64)])
+def test_flash_packed_matches_dense(h, d):
+    ks = jax.random.split(jax.random.key(6), 3)
+    q, k, v = (_rand(x, (2, 64, h, d)) for x in ks)
+    mask = np.ones((2, 64), bool)
+    mask[0, 50:] = False
+    mask = jnp.asarray(mask)
+    ref = dense_attention(q, k, v, mask)
+    out = flash_attention(q, k, v, mask, block_q=32, block_k=32, packing="flat")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_packed_grads_match_dense():
+    ks = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (_rand(x, (2, 64, 2, 64)) for x in ks)
+    mask = np.ones((2, 64), bool)
+    mask[1, 40:] = False
+    mask = jnp.asarray(mask)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, mask, block_q=32, block_k=32, packing="flat")
+            ** 2
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, mask) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_flash_packed_fully_masked_rows():
+    ks = jax.random.split(jax.random.key(8), 3)
+    q, k, v = (_rand(x, (1, 32, 4, 32)) for x in ks)
+    mask = jnp.zeros((1, 32), bool)
+    out = flash_attention(q, k, v, mask, block_q=32, block_k=32, packing="flat")
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_flash_packed_block_variant_matches_bh():
+    """flash_attention_block: packed o AND lse equal the bh path's, and the
+    lse cotangent rides the packed backward identically."""
+    from distributed_tensorflow_tpu.ops.flash_attention import (
+        flash_attention_block,
+    )
+
+    ks = jax.random.split(jax.random.key(9), 3)
+    q, k, v = (_rand(x, (2, 64, 2, 64)) for x in ks)
+    mask = np.ones((2, 64), bool)
+    mask[0, 48:] = False
+    mask = jnp.asarray(mask)
+
+    def run(packing):
+        return flash_attention_block(
+            q, k, v, mask, block_q=32, block_k=32, packing=packing
+        )
+
+    (o1, lse1), (o2, lse2) = run("flat"), run("bh")
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse1), np.asarray(lse2), atol=2e-5)
+
+    def loss(q, k, v, packing):
+        o, lse = flash_attention_block(
+            q, k, v, mask, block_q=32, block_k=32, packing=packing
+        )
+        live = jnp.where(lse > -1e29, lse, 0.0)
+        return jnp.sum(o**2) + 0.1 * jnp.sum(live)
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "flat")
+    g2 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "bh")
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_flash_packed_explicit_bad_geometry_raises():
+    """Explicit packing='flat' on an unsupported head shape must raise, not
+    silently return garbage (the head loop covers only hd//128 lane tiles)."""
+    ks = jax.random.split(jax.random.key(10), 3)
+    q, k, v = (_rand(x, (1, 32, 3, 64)) for x in ks)  # H*D=192
+    with pytest.raises(ValueError, match="128-lane"):
+        flash_attention(q, k, v, block_q=32, block_k=32, packing="flat")
+    q, k, v = (_rand(x, (1, 32, 2, 48)) for x in ks)  # 48 doesn't divide 128
+    with pytest.raises(ValueError, match="128-lane"):
+        flash_attention(q, k, v, block_q=32, block_k=32, packing="flat")
+
+
+def test_flash_packing_auto_rule():
+    """Auto picks flat only when whole heads tile 128-lane groups (and, in
+    compiled mode, when the blocks are 128-aligned)."""
+    from distributed_tensorflow_tpu.ops.flash_attention import (
+        _flat_auto,
+        _packing_ok,
+    )
+
+    assert _packing_ok(12, 64)  # BERT-base
+    assert _packing_ok(6, 64)  # tp=2 shard
+    assert _packing_ok(4, 32)
+    assert not _packing_ok(3, 64)  # tp=4 shard: 192 lanes
+    assert not _packing_ok(2, 48)  # 48 doesn't divide 128
+    assert _flat_auto(12, 64, 512, 512, False)
+    assert not _flat_auto(12, 64, 64, 512, False)  # misaligned block (TPU)
+    assert _flat_auto(12, 64, 64, 64, True)  # interpret mode: fine
