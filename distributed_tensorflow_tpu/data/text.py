@@ -378,6 +378,38 @@ def mlm_device_batches(
         (c, min(_ROW_CHUNK, global_batch - c * _ROW_CHUNK))
         for c in range(start_row // _ROW_CHUNK, -(-stop_row // _ROW_CHUNK))
     ]
+    def place(v):
+        # make_array_from_callback, not make_array_from_process_local_data:
+        # the local-data API infers the global shape from the local slab, so
+        # a SEQ-sharded dim spanning processes (each host holding the full L
+        # while "seq" shards it) is misread as a bigger global L — position
+        # ids run off the embedding table and the run NaNs (caught by the r5
+        # cross-process sp rehearsal). The callback form receives each
+        # addressable device's true GLOBAL index and slices both the row
+        # range (shifted by this host's start_row) and the L range from the
+        # locally generated rows — correct for dp, ep, seq, and any
+        # composition.
+        spec = spec_1d if v.ndim == 1 else spec_2d
+        gshape = (global_batch,) + v.shape[1:]
+
+        def cb(index, v=v):
+            rows = index[0]
+            r0 = (rows.start or 0) - start_row
+            r1 = (global_batch if rows.stop is None else rows.stop) - start_row
+            # Loud guard: a device whose rows fall outside this host's slab
+            # (a mesh whose dp axis is not process-contiguous in device
+            # order) must not wrap around via negative indexing and train
+            # on silently duplicated rows.
+            if r0 < 0 or r1 > len(v):
+                raise ValueError(
+                    f"device row range [{rows.start}, {rows.stop}) is outside "
+                    f"this host's generated slab [{start_row}, {stop_row}) — "
+                    "the mesh's data axis is not process-contiguous"
+                )
+            return v[(slice(r0, r1),) + tuple(index[1:])]
+
+        return jax.make_array_from_callback(gshape, spec, cb)
+
     # Stream-position indexed: batch k is a pure function of (seed, k), so a
     # restored run resumes at batch N instead of replaying 0..N-1.
     step = start_step
@@ -390,10 +422,5 @@ def mlm_device_batches(
             k: np.concatenate([c[k] for c in chunks], axis=0)
             for k in chunks[0]
         }
-        yield {
-            k: jax.make_array_from_process_local_data(
-                spec_1d if v.ndim == 1 else spec_2d, v
-            )
-            for k, v in local.items()
-        }
+        yield {k: place(v) for k, v in local.items()}
         step += 1

@@ -63,8 +63,13 @@ def main() -> int:
 
     if mode == "tp":
         return _tp_body(proc_id, nproc)
-    if mode in ("pp", "ep"):
-        rec = (pp_train if mode == "pp" else ep_train)()
+    if mode in ("pp", "ep", "sp_ring", "sp_ulysses"):
+        if mode == "pp":
+            rec = pp_train()
+        elif mode == "ep":
+            rec = ep_train()
+        else:
+            rec = sp_train(impl=mode.removeprefix("sp_"))
         rec["proc"] = proc_id
         rec["n_devices"] = len(jax.devices())
         print(json.dumps(rec))
@@ -232,7 +237,7 @@ def _digest_replicated(state, specs):
 
 
 def _bert_train(cfg_init, cfg_run, mesh_axes, *, expert_sharded=False,
-                n_steps=3, global_batch=16):
+                seq_sharded=False, n_steps=3, global_batch=16):
     """Shared body for the pp/ep rehearsals: runnable identically inside a
     2-process cluster (the worker modes) and in-process on the 8-virtual-
     device mesh (the launcher's reference run) — VERDICT r4 #3's
@@ -284,7 +289,9 @@ def _bert_train(cfg_init, cfg_run, mesh_axes, *, expert_sharded=False,
         make_bert_pretraining_loss(BertForPreTraining(cfg_run)),
         tx,
         mesh,
-        batch_spec=bert_batch_specs(mesh, expert_sharded=expert_sharded),
+        batch_spec=bert_batch_specs(
+            mesh, expert_sharded=expert_sharded, seq_sharded=seq_sharded
+        ),
         state_specs=specs,
         clip_norm=0.05,
     )
@@ -292,7 +299,8 @@ def _bert_train(cfg_init, cfg_run, mesh_axes, *, expert_sharded=False,
         SyntheticMLMConfig(vocab_size=cfg_init.vocab_size, seq_len=L, seed=0)
     )
     batches = mlm_device_batches(
-        data, mesh, global_batch, expert_sharded=expert_sharded, seed=3
+        data, mesh, global_batch, expert_sharded=expert_sharded,
+        seq_sharded=seq_sharded, seed=3,
     )
     losses = []
     metrics = {}
@@ -349,6 +357,30 @@ def ep_train(n_steps: int = 3):
     return _bert_train(
         base, run, {"expert": 8}, expert_sharded=True, global_batch=16,
         n_steps=n_steps,
+    )
+
+
+def sp_train(n_steps: int = 3, impl: str = "ring"):
+    """Pure-sp BERT on mesh {seq: 8}: under the 2-process cluster the
+    sequence axis SPANS the process boundary, so the ring's K/V ppermute
+    hops (impl="ring") or the Ulysses head<->sequence all_to_alls
+    (impl="ulysses") cross it on every layer of every step — the last
+    parallelism family without a cross-process rehearsal after r5 added
+    pp and ep."""
+    import dataclasses
+
+    from distributed_tensorflow_tpu.models.bert import BertConfig
+
+    # Ulysses shards heads over the seq axis -> needs num_heads % 8 == 0;
+    # the ring has no such constraint and uses the production head shape.
+    heads = 8 if impl == "ulysses" else 4
+    base = BertConfig(
+        vocab_size=96, hidden_size=32, num_layers=2, num_heads=heads,
+        intermediate_size=64, max_position=64, dropout_rate=0.0,
+    )
+    run = dataclasses.replace(base, seq_axis="seq", sp_impl=impl)
+    return _bert_train(
+        base, run, {"seq": 8}, seq_sharded=True, n_steps=n_steps
     )
 
 

@@ -209,6 +209,8 @@ def _reference_run(mode: str):
     sys.path.insert(0, str(_REPO / "tests"))
     import _mp_worker
 
+    if mode.startswith("sp_"):
+        return _mp_worker.sp_train(impl=mode.removeprefix("sp_"))
     return (_mp_worker.pp_train if mode == "pp" else _mp_worker.ep_train)()
 
 
@@ -218,7 +220,15 @@ def test_two_process_pipeline_parallel_localhost():
     the GPipe ppermute hand-off (and its wraparound) crosses the real
     process boundary on every tick. Both workers must agree bit-for-bit,
     and the trajectory must equal the single-process virtual-mesh run."""
-    outs = _launch_and_collect("pp")
+    _assert_cluster_matches_reference("pp")
+
+
+def _assert_cluster_matches_reference(mode: str):
+    """Shared contract: both workers bit-identical, trajectory equal to the
+    single-process virtual-mesh run."""
+    import numpy as np
+
+    outs = _launch_and_collect(mode)
     for o in outs:
         assert o["n_devices"] == 8
         assert o["step"] == 3
@@ -226,14 +236,26 @@ def test_two_process_pipeline_parallel_localhost():
     assert outs[0]["digest"] == outs[1]["digest"], outs
     assert outs[0]["losses"] == outs[1]["losses"], outs
 
-    ref = _reference_run("pp")
-    import numpy as np
-
+    ref = _reference_run(mode)
     np.testing.assert_allclose(outs[0]["losses"], ref["losses"], atol=1e-5)
     np.testing.assert_allclose(
         outs[0]["grad_norm"], ref["grad_norm"], rtol=1e-5
     )
     np.testing.assert_allclose(outs[0]["digest"], ref["digest"], atol=1e-4)
+
+
+def test_two_process_ring_sequence_parallel_localhost():
+    """Cross-process SEQUENCE parallelism, ring flavor (r5: the last
+    parallelism family without a 2-process rehearsal): mesh {seq: 8} puts
+    sequence shards 0-3 on process 0 and 4-7 on process 1, so the ring's
+    K/V ppermute hops cross the real process boundary on every layer."""
+    _assert_cluster_matches_reference("sp_ring")
+
+
+def test_two_process_ulysses_sequence_parallel_localhost():
+    """Cross-process SEQUENCE parallelism, Ulysses flavor: the
+    head<->sequence all_to_all pair crosses the process boundary."""
+    _assert_cluster_matches_reference("sp_ulysses")
 
 
 def test_two_process_expert_parallel_localhost():
@@ -241,19 +263,4 @@ def test_two_process_expert_parallel_localhost():
     GShard MoE on mesh {expert: 8} — the dispatch all_to_all routes
     tokens between experts 0-3 (process 0) and 4-7 (process 1) across the
     real boundary. Same contract as the pp rehearsal."""
-    outs = _launch_and_collect("ep")
-    for o in outs:
-        assert o["n_devices"] == 8
-        assert o["step"] == 3
-        assert o["n_replicated"] > 0
-    assert outs[0]["digest"] == outs[1]["digest"], outs
-    assert outs[0]["losses"] == outs[1]["losses"], outs
-
-    ref = _reference_run("ep")
-    import numpy as np
-
-    np.testing.assert_allclose(outs[0]["losses"], ref["losses"], atol=1e-5)
-    np.testing.assert_allclose(
-        outs[0]["grad_norm"], ref["grad_norm"], rtol=1e-5
-    )
-    np.testing.assert_allclose(outs[0]["digest"], ref["digest"], atol=1e-4)
+    _assert_cluster_matches_reference("ep")
