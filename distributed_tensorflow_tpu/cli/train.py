@@ -56,6 +56,7 @@ class WorkloadConfig:
     optimizer: str = "sgd"  # "sgd" | "adam" | "adamw"
     weight_decay: float = 0.0  # adamw decoupled weight decay
     clip_norm: float = 0.0  # >0: global-norm gradient clipping
+    grad_accum: int = 1  # >1: micro-slice gradient accumulation in-step
     lr_schedule: str = "constant"  # "constant" | "warmup_cosine" | "piecewise"
     warmup_steps: int = 0
     mode: str = "sync"  # "sync" | "stale"
@@ -71,6 +72,7 @@ class WorkloadConfig:
     moe_topk: int = 1  # routing fan-out: 1 = Switch, 2 = GShard top-2
     pipeline_parallel: int = 0  # >0: pipeline axis size, stage-sharded encoder (BERT)
     pipeline_microbatches: int = 0  # GPipe M; 0 -> 4 * pipeline_parallel
+    remat: bool = False  # activation remat over encoder layers (BERT)
     bert_layers: int = 0  # >0: override encoder depth (smoke runs)
     bert_hidden: int = 0  # >0: override hidden size (intermediate = 4x)
     bert_vocab: int = 0  # >0: override vocab size (smoke runs)
@@ -384,6 +386,10 @@ def _build_bert_workload(cfg_kwargs: dict):
                     pipeline_parallel=pp,
                     pipeline_microbatches=micro,
                 )
+            if cfg.remat:
+                # Training model only — init's one forward needs no remat,
+                # and the param tree is identical either way.
+                model_cfg = dataclasses.replace(model_cfg, remat=True)
             # Init outside shard_map must not bind the seq axis; the param
             # tree is identical either way (tests/test_bert.py).
             init_model_ = BertForPreTraining(init_cfg)
@@ -665,6 +671,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         batch_spec=pieces["batch_spec"],
         state_specs=state_specs,
         clip_norm=cfg.clip_norm,
+        grad_accum=cfg.grad_accum,
     )
 
     ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
@@ -797,6 +804,17 @@ def main(argv: list[str] | None = None):
                         "(GPipe schedule; 0 disables)")
     parser.add_argument("--pipeline-microbatches", type=int, default=0,
                         help="GPipe microbatch count M (default 4x stages)")
+    parser.add_argument("--grad-accum", type=int, default=0,
+                        help="accumulate gradients over N micro-slices of "
+                        "each device's batch inside the compiled step "
+                        "(mean of per-slice grads) — train global batches "
+                        "whose activations don't fit; composes with --remat")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialise encoder-layer activations during "
+                        "backward (jax.checkpoint): ~1 extra fwd pass of "
+                        "layer FLOPs for O(num_layers) less activation "
+                        "memory — enables longer --seq-len / larger batch "
+                        "per chip (BERT)")
     parser.add_argument("--expert-parallel", type=int, default=-1,
                         help="expert axis size for MoE sharding (BERT)")
     parser.add_argument("--bert-layers", type=int, default=0,
@@ -872,6 +890,12 @@ def main(argv: list[str] | None = None):
         overrides["pipeline_parallel"] = args.pipeline_parallel
     if args.pipeline_microbatches:
         overrides["pipeline_microbatches"] = args.pipeline_microbatches
+    if args.remat:
+        overrides["remat"] = True
+    if args.grad_accum:
+        if args.grad_accum < 1:
+            raise SystemExit("--grad-accum must be >= 1")
+        overrides["grad_accum"] = args.grad_accum
     if args.bert_layers:
         overrides["bert_layers"] = args.bert_layers
     if args.bert_hidden:

@@ -109,6 +109,14 @@ class BertConfig:
     pipeline_axis: str | None = None
     pipeline_parallel: int = 1
     pipeline_microbatches: int = 0  # 0 -> 4 * pipeline_parallel
+    # Activation rematerialisation (jax.checkpoint) over encoder layers:
+    # each layer's activations are recomputed during backward instead of
+    # saved, trading ~1 extra forward pass of layer FLOPs for O(num_layers)
+    # less activation memory — the standard lever for longer L / larger
+    # per-chip batch. Applies to all three encoder forms (module list,
+    # sequential scan, GPipe schedule); the math is unchanged, so
+    # trajectories are identical (tests/test_bert.py pins it).
+    remat: bool = False
 
 
 def bert_base(**overrides) -> BertConfig:
@@ -360,8 +368,10 @@ class MoeFfn(nn.Module):
 class BertLayer(nn.Module):
     cfg: BertConfig
 
+    # ``train`` is positional-or-keyword (no ``*``) so nn.remat can mark it
+    # static by argnum (self=0, x=1, mask=2, train=3) — see BertModel.setup.
     @nn.compact
-    def __call__(self, x, mask, *, train: bool = False):
+    def __call__(self, x, mask, train: bool = False):
         cfg = self.cfg
         x = BertSelfAttention(cfg, name="attention")(x, mask, train=train)
         if cfg.moe_experts:
@@ -438,8 +448,17 @@ class BertModel(nn.Module):
                     f"num_layers {cfg.num_layers} not divisible by "
                     f"pipeline_parallel {cfg.pipeline_parallel}"
                 )
+            scan_target = _ScanBertLayer
+            if cfg.remat:
+                # remat INSIDE the scan: each layer recomputes during the
+                # scan's backward sweep. prevent_cse=False — under scan the
+                # XLA CSE hazard remat guards against cannot occur, and
+                # leaving it True blocks useful fusion.
+                scan_target = nn.remat(
+                    _ScanBertLayer, static_argnums=(3,), prevent_cse=False
+                )
             self.encoder = nn.scan(
-                _ScanBertLayer,
+                scan_target,
                 # intermediates rides the scan too (stacked per layer):
                 # the MoE FFN sows its aux loss there, and the sequential-
                 # semantics path (init / single-stage runs) must carry it
@@ -451,8 +470,20 @@ class BertModel(nn.Module):
             )(cfg, name="encoder")
             self.layers = None
         else:
+            # prevent_cse=True (the default) is LOAD-BEARING here: under
+            # plain jit XLA would otherwise CSE the backward's recomputed
+            # forward against the saved one, silently restoring the full
+            # activation footprint (measured at L=512 b=96 bf16: temp
+            # 13.50 GiB unchanged with False; 5.12 GiB with True). Under
+            # scan the loop boundary already blocks that CSE, so the scan
+            # target above keeps False (the flax-recommended pairing).
+            layer_cls = (
+                nn.remat(BertLayer, static_argnums=(3,))
+                if cfg.remat
+                else BertLayer
+            )
             self.layers = [
-                BertLayer(cfg, name=f"layer_{i}") for i in range(cfg.num_layers)
+                layer_cls(cfg, name=f"layer_{i}") for i in range(cfg.num_layers)
             ]
         self.pooler = nn.Dense(
             cfg.hidden_size,
@@ -503,6 +534,13 @@ class BertModel(nn.Module):
                 return h2, sum(leaves) / len(leaves)
             return layer.apply({"params": p_one}, h, m, train=train, rngs=rngs)
 
+        if cfg.remat:
+            # Remat per (layer, microbatch) tick: the GPipe schedule's
+            # backward sweep recomputes each tick's layer activations
+            # instead of saving M x S of them. All layer_fn args are array
+            # pytrees (ctx's indices are traced scan counters).
+            layer_fn = jax.checkpoint(layer_fn, prevent_cse=False)
+
         out = pipeline_apply(
             layer_fn,
             stacked,
@@ -536,7 +574,10 @@ class BertModel(nn.Module):
                 x, _ = self.encoder(x, attention_mask, train)
         else:
             for layer in self.layers:
-                x = layer(x, attention_mask, train=train)
+                # train POSITIONALLY: with cfg.remat the layer class is
+                # nn.remat(BertLayer, static_argnums=(3,)) and the static
+                # marking only applies to positional args.
+                x = layer(x, attention_mask, train)
         first = x[:, 0]
         if cfg.seq_axis is not None:
             # The global [CLS] token lives on seq-shard 0: psum-select it so

@@ -745,15 +745,35 @@ def _packing_ok(h: int, d: int) -> bool:
     return d <= 128 and 128 % d == 0 and (h * d) % 128 == 0
 
 
-def _flat_auto(h, d, block_q, block_k, interpret) -> bool:
+def _flat_vmem_est(l, hd, block_q, block_k, esize=2) -> int:
+    """Rough VMEM bytes for one packed-kernel program: the K/V streams stay
+    RESIDENT at full [L, H*D] (double-buffered by Mosaic) — 12x the bh
+    kernels' per-head residency, which is what caps the packed path's L."""
+    kv = 2 * 2 * l * hd * esize          # k + v, double-buffered
+    blocks = 3 * block_q * hd * esize     # q/o/do-class blocks
+    scores = block_q * block_k * 4        # one f32 score tile
+    carries = 6 * block_q * 128 * 4       # per-tile o/m/denom f32 carries
+    return kv + blocks + scores + carries
+
+
+# Measured on this chip: l=2048 hd=768 blows the 16 MB scoped-vmem budget
+# (Mosaic: 18.21M requested); l <= 1024 at hd=768 fits. 14 MB keeps margin.
+_FLAT_VMEM_LIMIT = 14 * 1024 * 1024
+
+
+def _flat_auto(h, d, block_q, block_k, interpret, l=0) -> bool:
     # Compiled-mode lane slices (lse/delta/mask at block offsets) need
     # 128-aligned blocks; interpret mode has no such constraint.
     if not _packing_ok(h, d):
         return False
-    return interpret or (block_q % 128 == 0 and block_k % 128 == 0)
+    if interpret:
+        return True
+    if block_q % 128 or block_k % 128:
+        return False
+    return _flat_vmem_est(l, h * d, block_q, block_k) <= _FLAT_VMEM_LIMIT
 
 
-def _require_flat(h, d, block_q, block_k, interpret) -> None:
+def _require_flat(h, d, block_q, block_k, interpret, l=0) -> None:
     """Loud guard for EXPLICIT packing="flat": an unsupported geometry must
     not reach the kernels — the head loop covers only hd//128 lane tiles, so
     e.g. H*D=192 leaves lanes 128-191 unread and returns garbage (silently
@@ -768,6 +788,15 @@ def _require_flat(h, d, block_q, block_k, interpret) -> None:
         raise ValueError(
             f"packing='flat' compiled for TPU needs 128-aligned blocks "
             f"(lane-slice rule); got block_q={block_q}, block_k={block_k}. "
+            "Use packing='bh' or None (auto)."
+        )
+    if not interpret and (
+        _flat_vmem_est(l, h * d, block_q, block_k) > _FLAT_VMEM_LIMIT
+    ):
+        raise ValueError(
+            f"packing='flat' keeps K/V resident at [L={l}, H*D={h * d}] in "
+            f"VMEM — past the ~16 MB budget at this geometry (est "
+            f"{_flat_vmem_est(l, h * d, block_q, block_k) >> 20} MB). "
             "Use packing='bh' or None (auto)."
         )
 
@@ -806,9 +835,9 @@ def flash_attention_block(
     if mask is None:
         mask = jnp.ones((b, l), bool)
     if packing is None:
-        packing = "flat" if _flat_auto(h, d, block_q, block_k, interpret) else "bh"
+        packing = "flat" if _flat_auto(h, d, block_q, block_k, interpret, l) else "bh"
     elif packing == "flat":
-        _require_flat(h, d, block_q, block_k, interpret)
+        _require_flat(h, d, block_q, block_k, interpret, l)
 
     if packing == "flat":
         mask_f = mask.astype(jnp.float32).reshape(b, 1, l)
@@ -872,9 +901,13 @@ def flash_attention(
         v = jnp.pad(v, pad)
         mask = jnp.pad(mask, ((0, 0), (0, l_pad - l)))
     if packing is None:
-        packing = "flat" if _flat_auto(h, d, block_q, block_k, interpret) else "bh"
+        packing = (
+            "flat"
+            if _flat_auto(h, d, block_q, block_k, interpret, l_pad)
+            else "bh"
+        )
     elif packing == "flat":
-        _require_flat(h, d, block_q, block_k, interpret)
+        _require_flat(h, d, block_q, block_k, interpret, l_pad)
 
     if packing == "flat":
         mask_f = mask.astype(jnp.float32).reshape(b, 1, l_pad)

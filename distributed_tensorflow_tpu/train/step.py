@@ -116,6 +116,7 @@ def make_train_step(
     state_specs: "TrainState | None" = None,
     clip_norm: float = 0.0,
     donate: bool = True,
+    grad_accum: int = 1,
 ):
     """Build the compiled ``train_step(state, batch, rng) -> (state, metrics)``.
 
@@ -148,11 +149,27 @@ def make_train_step(
         norms psum'd over their sharding axes) and applies one identical
         scale everywhere. Semantics match optax.clip_by_global_norm.
       donate: donate state buffers so params update in place in HBM.
+      grad_accum: > 1 splits each device's batch rows into that many
+        micro-slices and accumulates their gradients in one lax.scan
+        BEFORE the DP/shard-axis reductions (which are linear, so the
+        grad contract is untouched) — the standard big-global-batch lever
+        when activations for the full per-device batch don't fit
+        (composes with --remat). Semantics, stated: the accumulated grad
+        is the MEAN of per-slice grads — exactly the full-batch grad for
+        row-mean losses (pinned in tests/test_grad_accum.py), and the
+        conventional mean-of-ratios for ratio-normalized losses like
+        BERT's MLM (each slice normalizes by its own masked-token count).
+        Dropout draws fold a per-slice rng (same distribution, different
+        draws than the unsliced step); batch-norm models see per-slice
+        batch statistics with EMAs averaged — the same ghost-BN semantics
+        the DP axes already have (models/resnet.py).
     """
     if mode not in ("sync", "stale"):
         raise ValueError(f"mode must be 'sync' or 'stale', got {mode!r}")
     if mode == "stale" and staleness < 1:
         raise ValueError("mode='stale' requires staleness >= 1")
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     dp_axes = data_axes(mesh)
     if batch_spec is None:
         batch_spec = batch_pspec(mesh)
@@ -201,9 +218,67 @@ def make_train_step(
             rng = jax.random.fold_in(rng, lax.axis_index(ax))
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (model_state, metrics)), grads = grad_fn(
-            state.params, state.model_state, batch, rng
-        )
+        if grad_accum > 1:
+            rows = jax.tree.leaves(batch)[0].shape[0]
+            if rows % grad_accum:
+                raise ValueError(
+                    f"per-device batch rows {rows} not divisible by "
+                    f"grad_accum {grad_accum}"
+                )
+            micro = jax.tree.map(
+                lambda x: x.reshape((grad_accum, rows // grad_accum) + x.shape[1:]),
+                batch,
+            )
+
+            def accum_body(carry, mb_a):
+                mb, a = mb_a
+                (loss_a, (ms_a, metrics_a)), g_a = grad_fn(
+                    state.params,
+                    state.model_state,
+                    mb,
+                    jax.random.fold_in(rng, a),
+                )
+                g_sum, l_sum, ms_sum, m_sum = carry
+                g_sum = jax.tree.map(jnp.add, g_sum, g_a)
+                ms_sum = jax.tree.map(jnp.add, ms_sum, ms_a)
+                m_sum = jax.tree.map(jnp.add, m_sum, dict(metrics_a))
+                return (g_sum, l_sum + loss_a, ms_sum, m_sum), None
+
+            # One probe trace sizes the carry zeros (shapes only, no FLOPs
+            # at runtime — eval_shape never executes).
+            shapes = jax.eval_shape(
+                grad_fn,
+                state.params,
+                state.model_state,
+                jax.tree.map(lambda x: x[0], micro),
+                rng,
+            )
+            (_, (ms_shape, metric_shape)), g_shape = shapes
+            zeros = lambda t: jax.tree.map(  # noqa: E731
+                lambda s: jnp.zeros(s.shape, s.dtype), t
+            )
+            init = (
+                zeros(g_shape),
+                jnp.zeros((), jnp.float32),
+                zeros(ms_shape),
+                zeros(dict(metric_shape)),
+            )
+            (g_sum, l_sum, ms_sum, m_sum), _ = lax.scan(
+                accum_body, init, (micro, jnp.arange(grad_accum))
+            )
+            inv = 1.0 / grad_accum
+            grads = jax.tree.map(lambda g: g * jnp.asarray(inv, g.dtype), g_sum)
+            loss = l_sum * inv
+            model_state = jax.tree.map(
+                lambda s: s * jnp.asarray(inv, s.dtype), ms_sum
+            )
+            metrics = jax.tree.map(
+                lambda m: m * jnp.asarray(inv, m.dtype), m_sum
+            )
+        else:
+            (loss, (model_state, metrics)), grads = grad_fn(
+                state.params, state.model_state, batch, rng
+            )
         metrics = dict(metrics)
         metrics["loss"] = loss
 

@@ -112,6 +112,26 @@ def test_cli_lr_schedule_and_eval(tmp_path):
     assert all("eval_loss" in r for r in evals)
 
 
+def test_cli_bert_tiny_remat(tmp_path):
+    """--remat trains through the entrypoint (jax.checkpoint'd encoder)."""
+    rc = main(
+        [
+            "--config=bert_base",
+            "--steps=2",
+            "--global-batch=8",
+            "--bert-layers=2",
+            "--bert-hidden=32",
+            "--bert-vocab=256",
+            "--remat",
+            "--log-every=1",
+            f"--metrics-jsonl={tmp_path}/m.jsonl",
+        ]
+    )
+    assert rc == 0
+    lines = [json.loads(x) for x in (tmp_path / "m.jsonl").read_text().splitlines()]
+    assert any("loss" in r for r in lines)
+
+
 def test_cli_bert_tiny_moe_and_eval(tmp_path):
     """Smoke-scale BERT overrides: MoE + EP + eval through the entrypoint."""
     rc = main(

@@ -1,0 +1,144 @@
+"""In-step gradient accumulation (make_train_step(grad_accum=N) / --grad-accum).
+
+The accumulated gradient is the MEAN of per-slice grads, taken BEFORE the
+DP pmean — for row-mean losses that equals the full-batch gradient exactly
+(mean of equal-size slice-means == global mean), so the whole trajectory
+must match the unaccumulated step to float-reduction tolerance. Ratio-
+normalized losses (BERT MLM) get the conventional mean-of-ratios; pinned
+as approximate agreement plus convergence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.data import (
+    device_batches,
+    synthetic_image_classification,
+)
+from distributed_tensorflow_tpu.models import LeNet5
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_tpu.train import create_train_state, make_train_step
+from distributed_tensorflow_tpu.train.objectives import (
+    init_model,
+    make_classification_loss,
+)
+from distributed_tensorflow_tpu.train.step import place_state
+
+
+def _lenet_setup(devices8, staleness=0):
+    mesh = build_mesh({"data": -1})
+    model = LeNet5()
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1), jnp.float32)
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    state = place_state(
+        create_train_state(params, tx, model_state, staleness=staleness), mesh
+    )
+    loss_fn = make_classification_loss(model)
+    ds = synthetic_image_classification(512, (28, 28, 1), 10, seed=0)
+    batches = device_batches(ds, mesh, global_batch=64, seed=1)
+    return mesh, tx, state, loss_fn, batches
+
+
+def test_grad_accum_matches_plain_step(devices8):
+    """LeNet sync DP (row-mean loss): grad_accum=4 trajectory equals the
+    plain step on the same batches to float-reduction tolerance."""
+    mesh, tx, state0, loss_fn, batches = _lenet_setup(devices8)
+    fixed = [next(batches) for _ in range(4)]
+    rng = jax.random.key(0)
+
+    def run(ga):
+        # donate=False: both runs start from the same state0 buffers.
+        step = make_train_step(loss_fn, tx, mesh, grad_accum=ga, donate=False)
+        state = state0
+        losses = []
+        for b in fixed:
+            state, metrics = step(state, b, rng)
+            losses.append(float(metrics["loss"]))
+        return losses, state.params
+
+    losses_1, params_1 = run(1)
+    losses_4, params_4 = run(4)
+    np.testing.assert_allclose(losses_1, losses_4, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(params_1), jax.tree.leaves(params_4)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        )
+
+
+def test_grad_accum_stale_mode_composes(devices8):
+    """Accumulation happens before the stale ring buffer: stale-K training
+    with grad_accum stays finite and steps the buffer exactly as without."""
+    mesh, tx, state, loss_fn, batches = _lenet_setup(devices8, staleness=2)
+    step = make_train_step(
+        loss_fn, tx, mesh, mode="stale", staleness=2, grad_accum=2
+    )
+    rng = jax.random.key(0)
+    for _ in range(4):
+        state, metrics = step(state, next(batches), rng)
+        assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 4
+
+
+def test_grad_accum_indivisible_rows_raise(devices8):
+    mesh, tx, state, loss_fn, batches = _lenet_setup(devices8)
+    step = make_train_step(loss_fn, tx, mesh, grad_accum=3)
+    with pytest.raises(ValueError, match="not divisible by"):
+        step(state, next(batches), jax.random.key(0))  # 8 rows/device, ga=3
+
+
+def test_grad_accum_bert_ratio_loss(devices8):
+    """BERT MLM (ratio loss): mean-of-ratios ~ full-batch ratio on the
+    synthetic stream (masked counts are iid across slices), and training
+    converges under accumulation."""
+    from distributed_tensorflow_tpu.data.text import (
+        SyntheticMLM,
+        SyntheticMLMConfig,
+        mlm_device_batches,
+    )
+    from distributed_tensorflow_tpu.models.bert import (
+        BertConfig,
+        BertForPreTraining,
+        make_bert_pretraining_loss,
+    )
+
+    mesh = build_mesh({"data": -1})
+    cfg = BertConfig(
+        vocab_size=100, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, max_position=64, dropout_rate=0.0,
+    )
+    model = BertForPreTraining(cfg)
+    params = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, 32), jnp.int32),
+        jnp.ones((1, 32), bool),
+        jnp.zeros((1, 32), jnp.int32),
+        train=False,
+    )["params"]
+    tx = optax.adam(3e-3)
+    loss_fn = make_bert_pretraining_loss(model)
+    data = SyntheticMLM(SyntheticMLMConfig(vocab_size=100, seq_len=32, seed=1))
+    rng = jax.random.key(0)
+
+    def run(ga, n=30):
+        state = place_state(create_train_state(params, tx), mesh)
+        step = make_train_step(loss_fn, tx, mesh, grad_accum=ga, donate=False)
+        batches = mlm_device_batches(data, mesh, global_batch=64, seed=0)
+        losses = []
+        for _ in range(n):
+            state, metrics = step(state, next(batches), rng)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    plain, accum = run(1), run(2)
+    # Step-1 losses use identical params: ratio vs mean-of-ratios only.
+    np.testing.assert_allclose(plain[0], accum[0], rtol=0.05)
+    # Both converge to the same neighborhood.
+    assert np.mean(accum[-5:]) < np.mean(accum[:5]) * 0.95
+    np.testing.assert_allclose(
+        np.mean(plain[-5:]), np.mean(accum[-5:]), rtol=0.1
+    )
