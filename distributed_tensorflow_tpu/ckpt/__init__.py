@@ -8,4 +8,7 @@ collective (every host participates, arrays written sharded), asynchronous
 restore is just "build the abstract state, load the latest into it".
 """
 
-from distributed_tensorflow_tpu.ckpt.checkpoint import Checkpointer  # noqa: F401
+from distributed_tensorflow_tpu.ckpt.checkpoint import (  # noqa: F401
+    Checkpointer,
+    restore_serving_state,
+)

@@ -86,3 +86,21 @@ class Checkpointer:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def restore_serving_state(directory: str | Path, template_state: Any):
+    """Load the newest training checkpoint for the INFERENCE engine.
+
+    ``template_state`` is a TrainState built exactly like the training run's
+    (same optimizer/staleness, so the pytree structure matches the saved
+    one); its arrays may carry SERVING placements — tensorstore reshards on
+    read, so a TP/PP-sharded training checkpoint restores cleanly onto a
+    replicated single-host serving mesh. Returns ``(params, model_state,
+    step)``. Raises ``FileNotFoundError`` when the directory holds no
+    checkpoint: serving must never silently answer from random init.
+    """
+    with Checkpointer(directory, use_async=False) as ckpt:
+        if ckpt.latest_step() is None:
+            raise FileNotFoundError(f"no checkpoint found under {directory}")
+        state, step = ckpt.restore_latest(template_state)
+    return state.params, state.model_state, step

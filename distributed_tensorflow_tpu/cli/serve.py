@@ -1,0 +1,198 @@
+"""``python -m distributed_tensorflow_tpu.cli.serve --config=<workload>``.
+
+Serve a trained checkpoint behind the dynamic micro-batcher: rebuild the
+workload's model exactly as training did (same preset + overrides), restore
+the newest checkpoint from ``--ckpt-dir`` onto a DP-only serving mesh,
+AOT-compile the forward per sequence bucket / image geometry, and expose it
+over HTTP (serve/server.py routes).
+
+The config flags MUST match the training run's — the checkpoint template is
+rebuilt from them (same optimizer, same staleness), and a mismatched tree
+fails loudly at restore rather than serving garbage.
+
+``--selftest N`` runs N synthetic requests through the in-process
+:class:`Client` instead of binding a port (CI smoke; also a quick "does
+this checkpoint answer" check) and prints the metrics snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def build_serving_client(cfg, args):
+    """Workload config -> (Client, payload_maker) over the restored ckpt."""
+    import jax
+
+    from distributed_tensorflow_tpu.ckpt import restore_serving_state
+    from distributed_tensorflow_tpu.cli.train import _make_tx
+    from distributed_tensorflow_tpu.obs import ServeMetrics
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        build_mesh,
+        initialize_runtime,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        BertInferenceEngine,
+        Client,
+        ImageClassifierEngine,
+    )
+    from distributed_tensorflow_tpu.train import create_train_state
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    initialize_runtime()
+    # Serving mesh is DP-only: the workload builders see no seq/model/
+    # expert/pipeline axes and hand back the axis-free model; tensorstore
+    # reshards the (possibly TP/PP-sharded) checkpoint onto it at restore.
+    mesh = build_mesh({"data": -1})
+    pieces = cfg.build(cfg)(mesh)
+
+    # The restore template: a TrainState built exactly like training's
+    # (same tx -> same opt_state slots, same staleness -> same grad ring).
+    tx, _ = _make_tx(cfg)
+    host_state = create_train_state(
+        pieces["params"],
+        tx,
+        pieces["model_state"],
+        staleness=cfg.staleness if cfg.mode == "stale" else 0,
+    )
+    template = place_state(host_state, mesh, None)
+    params, model_state, step = restore_serving_state(args.ckpt_dir, template)
+    logger.info("restored %s step %d for serving", cfg.name, step)
+
+    metrics = ServeMetrics()
+    if "image_shape" in pieces:
+        shape = pieces["image_shape"]
+        engine = ImageClassifierEngine(
+            pieces["model"],
+            params,
+            model_state,
+            mesh,
+            image_shape=shape,
+            max_batch=args.max_batch,
+            top_k=args.top_k,
+        )
+
+        def make_payload(rng: np.random.Generator) -> dict:
+            return {"image": rng.standard_normal(shape).astype(np.float32)}
+
+    else:
+        engine = BertInferenceEngine(
+            pieces["model"],
+            params,
+            mesh,
+            buckets=tuple(args.buckets),
+            max_batch=args.max_batch,
+        )
+        vocab = pieces["model"].cfg.vocab_size
+
+        def make_payload(rng: np.random.Generator) -> dict:
+            l = int(rng.integers(4, engine.buckets[-1] + 1))
+            ids = rng.integers(5, vocab, size=l)
+            return {"input_ids": ids, "mlm_targets": ids}
+
+    client = Client(
+        engine,
+        BatcherConfig(
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
+        ),
+        metrics=metrics,
+    )
+    return client, make_payload
+
+
+def _selftest(client, make_payload, n: int) -> int:
+    rng = np.random.default_rng(0)
+    futures = [client.submit(make_payload(rng)) for _ in range(n)]
+    results = [f.result(timeout=120) for f in futures]
+    assert len(results) == n
+    snap = client.metrics.snapshot()
+    print(json.dumps(snap, indent=2, default=float))
+    logger.info("selftest ok: %d requests served", n)
+    return 0
+
+
+def main(argv: list[str] | None = None):
+    from distributed_tensorflow_tpu.cli.train import PRESETS
+
+    parser = argparse.ArgumentParser(
+        description="serve a trained checkpoint (dynamic-batching inference)"
+    )
+    parser.add_argument("--config", required=True, choices=sorted(PRESETS))
+    parser.add_argument("--ckpt-dir", required=True,
+                        help="training checkpoint directory (newest step served)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000,
+                        help="0 = ephemeral (logged at startup)")
+    parser.add_argument("--buckets", type=int, nargs="+",
+                        default=[128, 256, 512],
+                        help="sequence-length buckets (clamped to the "
+                        "model's max_position); one executable each")
+    parser.add_argument("--max-batch", type=int, default=8,
+                        help="fixed executable batch size / flush size")
+    parser.add_argument("--max-delay-ms", type=float, default=8.0,
+                        help="flush a partial batch after this wait")
+    parser.add_argument("--max-queue", type=int, default=64,
+                        help="queue bound; beyond -> 429 + Retry-After")
+    parser.add_argument("--top-k", type=int, default=5,
+                        help="classes returned per classify request")
+    # Model-geometry overrides — MUST match the training run's.
+    parser.add_argument("--bert-layers", type=int, default=0)
+    parser.add_argument("--bert-hidden", type=int, default=0)
+    parser.add_argument("--bert-vocab", type=int, default=0)
+    parser.add_argument("--image-size", type=int, default=0)
+    parser.add_argument("--staleness", type=int, default=-1,
+                        help="training run's staleness (stale-mode ckpts)")
+    parser.add_argument("--selftest", type=int, default=0,
+                        help="serve N synthetic requests in-process and "
+                        "exit (no HTTP socket)")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s: %(message)s"
+    )
+    cfg = PRESETS[args.config]
+    overrides = {}
+    for k in ("bert_layers", "bert_hidden", "bert_vocab", "image_size"):
+        if getattr(args, k):
+            overrides[k] = getattr(args, k)
+    if args.staleness >= 0:
+        overrides["staleness"] = args.staleness
+        overrides["mode"] = "stale" if args.staleness else "sync"
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+
+    client, make_payload = build_serving_client(cfg, args)
+    try:
+        if args.selftest:
+            return _selftest(client, make_payload, args.selftest)
+        from distributed_tensorflow_tpu.serve import build_http_server
+
+        server = build_http_server(client, args.host, args.port)
+        logger.info(
+            "ready on http://%s:%d (POST /v1/%s)",
+            *server.server_address,
+            "classify" if hasattr(client.engine, "image_shape") else "mlm",
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            logger.info("shutting down")
+        finally:
+            server.server_close()
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

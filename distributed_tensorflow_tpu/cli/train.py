@@ -268,6 +268,10 @@ def _build_image_workload(
             return {
                 "params": params,
                 "model_state": model_state,
+                # Serving hooks (cli/serve.py): the bare module + the input
+                # geometry its executables must be compiled for.
+                "model": net,
+                "image_shape": shape,
                 "loss_fn": make_classification_loss(net),
                 "batches": lambda start_step=0: _image_batches(
                     cfg, ds, mesh, shape[:2], train=True, seed=1, start_step=start_step
@@ -468,6 +472,11 @@ def _build_bert_workload(cfg_kwargs: dict):
 
             return {
                 "params": variables["params"],
+                # Serving hook (cli/serve.py): the axis-free model — serving
+                # meshes are DP-only, so the engine wants the same module
+                # init used (no seq/model/pipeline axes bound; stacked
+                # pipeline params run the sequential scan).
+                "model": init_model_,
                 "param_specs": (
                     bert_param_specs(
                         variables["params"],

@@ -1,0 +1,52 @@
+"""jax version compatibility shims (imported for side effects).
+
+The codebase targets the current jax API surface; on older runtimes
+(0.4.x) two symbols it uses everywhere are missing, so the package
+installs drop-in aliases at import time rather than scattering
+version branches through every call site:
+
+- ``jax.shard_map`` — lived at ``jax.experimental.shard_map.shard_map``
+  with ``check_rep`` instead of ``check_vma``.
+- ``jax.lax.axis_size`` — ``jax.core.axis_frame(name)`` returns the same
+  static int inside a binding shard_map/pmap, and raises the same
+  ``NameError`` on unbound names (models/bert.py ``_axis_bound`` relies
+  on that).
+
+Both installs are guarded: on a jax that already exports the symbol this
+module is a no-op, and the shims can be deleted once the floor runtime
+moves past 0.4.x.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+
+
+def _install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+            return _shard_map(
+                f,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_rep=check_vma,
+            )
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+
+        def axis_size(axis_name):
+            if isinstance(axis_name, (tuple, list)):
+                return math.prod(jax.core.axis_frame(a) for a in axis_name)
+            return jax.core.axis_frame(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+
+_install()

@@ -613,10 +613,7 @@ class BertForPreTraining(nn.Module):
             2, dtype=jnp.float32, kernel_init=nn.initializers.normal(0.02)
         )
 
-    def __call__(self, input_ids, attention_mask, token_type_ids, *, train=False):
-        hidden, pooled = self.bert(
-            input_ids, attention_mask, token_type_ids, train=train
-        )
+    def _heads(self, hidden, pooled):
         h = self.mlm_ln(nn.gelu(self.mlm_transform(hidden), approximate=True))
         # Tied decoder: logits against the word-embedding table. Logits KEEP
         # the compute dtype: at BERT geometry the [B, L, V] tensor is the
@@ -631,6 +628,23 @@ class BertForPreTraining(nn.Module):
         )
         nsp_logits = self.nsp_head(pooled)
         return mlm_logits, nsp_logits.astype(jnp.float32)
+
+    def __call__(self, input_ids, attention_mask, token_type_ids, *, train=False):
+        hidden, pooled = self.bert(
+            input_ids, attention_mask, token_type_ids, train=train
+        )
+        return self._heads(hidden, pooled)
+
+    def serve_outputs(self, input_ids, attention_mask, token_type_ids):
+        """Inference-only forward for the serving engine (serve/engine.py):
+        one encoder pass yielding ``(mlm_logits, nsp_logits, pooled)`` —
+        the MLM scoring surface plus the pooled [CLS] sentence embedding,
+        without a second encoder pass for the embedding endpoint."""
+        hidden, pooled = self.bert(
+            input_ids, attention_mask, token_type_ids, train=False
+        )
+        mlm_logits, nsp_logits = self._heads(hidden, pooled)
+        return mlm_logits, nsp_logits, pooled
 
 
 def _mlm_stats(mlm_logits, batch, seq_axis):

@@ -9,7 +9,11 @@ TensorBoard's profile plugin (xprof).
 """
 
 from distributed_tensorflow_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
     JsonlWriter,
+    ServeMetrics,
     TensorBoardWriter,
     make_metric_hook,
 )

@@ -1,13 +1,19 @@
-"""Metric writers: TensorBoard scalars and append-only JSONL.
+"""Metric writers and serving instruments.
 
-Only process 0 writes (the reference gated summaries on the chief the same
-way, SURVEY.md §5); other hosts get no-op hooks, so call sites stay
-branch-free.
+Training side: TensorBoard scalars and append-only JSONL. Only process 0
+writes (the reference gated summaries on the chief the same way,
+SURVEY.md §5); other hosts get no-op hooks, so call sites stay branch-free.
+
+Serving side (serve/): thread-safe :class:`Counter` / :class:`Gauge` /
+:class:`Histogram` primitives and the :class:`ServeMetrics` bundle — the
+per-request latency histogram (p50/p99), queue-depth and batch-occupancy
+gauges the inference engine exposes at ``GET /metrics``.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 import time
 from pathlib import Path
 
@@ -46,6 +52,128 @@ class TensorBoardWriter:
 
     def close(self) -> None:
         self._sw.close()
+
+
+class Counter:
+    """Thread-safe monotonically-increasing counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._n += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._n
+
+
+class Gauge:
+    """Thread-safe last-value gauge (queue depth, in-flight batch size)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+
+class Histogram:
+    """Thread-safe value histogram with percentile summaries.
+
+    Keeps exact count/sum/max over the full stream plus a bounded ring of
+    recent samples for the percentile estimates — serving runs are
+    unbounded, so the sample buffer must not grow with traffic.
+    """
+
+    def __init__(self, max_samples: int = 8192):
+        self._lock = threading.Lock()
+        self._buf: list[float] = []
+        self._max_samples = max_samples
+        self._i = 0
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.max = max(self.max, v)
+            if len(self._buf) < self._max_samples:
+                self._buf.append(v)
+            else:
+                self._buf[self._i] = v
+                self._i = (self._i + 1) % self._max_samples
+
+    def reset(self) -> None:
+        """Zero the stream (per-measurement-window use, e.g. serve_bench)."""
+        with self._lock:
+            self._buf.clear()
+            self._i = 0
+            self.count = 0
+            self.total = 0.0
+            self.max = 0.0
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100] over the retained sample window (0.0 when empty)."""
+        with self._lock:
+            if not self._buf:
+                return 0.0
+            s = sorted(self._buf)
+        k = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+        return s[k]
+
+    def summary(self) -> dict:
+        with self._lock:
+            count, total, mx = self.count, self.total, self.max
+        return {
+            "count": count,
+            "mean": total / count if count else 0.0,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+            "max": mx,
+        }
+
+
+class ServeMetrics:
+    """The serving subsystem's observability bundle (serve/batcher.py wires
+    it; serve/server.py exposes it as JSON at ``GET /metrics``)."""
+
+    def __init__(self):
+        self.latency = Histogram()          # seconds, submit -> reply
+        self.batch_occupancy = Histogram()  # rows per flushed batch
+        self.queue_depth = Gauge()
+        self.requests = Counter()
+        self.rejected = Counter()           # backpressure rejections
+        self.batches = Counter()
+        self.errors = Counter()             # batches that raised
+
+    def snapshot(self) -> dict:
+        lat = self.latency.summary()
+        return {
+            "requests": self.requests.value,
+            "rejected": self.rejected.value,
+            "batches": self.batches.value,
+            "errors": self.errors.value,
+            "queue_depth": self.queue_depth.value,
+            "latency_ms": {
+                k: (v * 1e3 if k != "count" else v) for k, v in lat.items()
+            },
+            "batch_occupancy": self.batch_occupancy.summary(),
+        }
 
 
 def make_metric_hook(

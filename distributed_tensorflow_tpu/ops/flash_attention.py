@@ -761,19 +761,22 @@ def _flat_vmem_est(l, hd, block_q, block_k, esize=2) -> int:
 _FLAT_VMEM_LIMIT = 14 * 1024 * 1024
 
 
-def _flat_auto(h, d, block_q, block_k, interpret, l=0) -> bool:
+def _flat_auto(h, d, block_q, block_k, interpret, l=0, esize=2) -> bool:
     # Compiled-mode lane slices (lse/delta/mask at block offsets) need
-    # 128-aligned blocks; interpret mode has no such constraint.
+    # 128-aligned blocks; interpret mode has no such constraint. ``esize``
+    # is the operand element size — f32 K/V streams are twice the bf16
+    # residency, so the auto rule must see the real dtype or it selects
+    # 'flat' at geometries that blow the scoped-vmem budget.
     if not _packing_ok(h, d):
         return False
     if interpret:
         return True
     if block_q % 128 or block_k % 128:
         return False
-    return _flat_vmem_est(l, h * d, block_q, block_k) <= _FLAT_VMEM_LIMIT
+    return _flat_vmem_est(l, h * d, block_q, block_k, esize) <= _FLAT_VMEM_LIMIT
 
 
-def _require_flat(h, d, block_q, block_k, interpret, l=0) -> None:
+def _require_flat(h, d, block_q, block_k, interpret, l=0, esize=2) -> None:
     """Loud guard for EXPLICIT packing="flat": an unsupported geometry must
     not reach the kernels — the head loop covers only hd//128 lane tiles, so
     e.g. H*D=192 leaves lanes 128-191 unread and returns garbage (silently
@@ -791,12 +794,12 @@ def _require_flat(h, d, block_q, block_k, interpret, l=0) -> None:
             "Use packing='bh' or None (auto)."
         )
     if not interpret and (
-        _flat_vmem_est(l, h * d, block_q, block_k) > _FLAT_VMEM_LIMIT
+        _flat_vmem_est(l, h * d, block_q, block_k, esize) > _FLAT_VMEM_LIMIT
     ):
         raise ValueError(
             f"packing='flat' keeps K/V resident at [L={l}, H*D={h * d}] in "
             f"VMEM — past the ~16 MB budget at this geometry (est "
-            f"{_flat_vmem_est(l, h * d, block_q, block_k) >> 20} MB). "
+            f"{_flat_vmem_est(l, h * d, block_q, block_k, esize) >> 20} MB). "
             "Use packing='bh' or None (auto)."
         )
 
@@ -835,9 +838,15 @@ def flash_attention_block(
     if mask is None:
         mask = jnp.ones((b, l), bool)
     if packing is None:
-        packing = "flat" if _flat_auto(h, d, block_q, block_k, interpret, l) else "bh"
+        packing = (
+            "flat"
+            if _flat_auto(
+                h, d, block_q, block_k, interpret, l, q.dtype.itemsize
+            )
+            else "bh"
+        )
     elif packing == "flat":
-        _require_flat(h, d, block_q, block_k, interpret, l)
+        _require_flat(h, d, block_q, block_k, interpret, l, q.dtype.itemsize)
 
     if packing == "flat":
         mask_f = mask.astype(jnp.float32).reshape(b, 1, l)
@@ -903,11 +912,15 @@ def flash_attention(
     if packing is None:
         packing = (
             "flat"
-            if _flat_auto(h, d, block_q, block_k, interpret, l_pad)
+            if _flat_auto(
+                h, d, block_q, block_k, interpret, l_pad, q.dtype.itemsize
+            )
             else "bh"
         )
     elif packing == "flat":
-        _require_flat(h, d, block_q, block_k, interpret, l_pad)
+        _require_flat(
+            h, d, block_q, block_k, interpret, l_pad, q.dtype.itemsize
+        )
 
     if packing == "flat":
         mask_f = mask.astype(jnp.float32).reshape(b, 1, l_pad)

@@ -1,0 +1,29 @@
+"""Serving subsystem: dynamic-batching inference over trained checkpoints.
+
+The ROADMAP north star is a system that "serves heavy traffic from millions
+of users" — this package is the layer users actually touch, built on the
+same sharded-model, checkpoint, and observability infrastructure as
+training rather than a separate stack:
+
+- ``engine.py``  — checkpoint-loading, mesh-sharded, AOT-compiled forward
+  engines with sequence-length bucketing (one executable per bucket built
+  at startup, so no request ever pays a trace).
+- ``batcher.py`` — dynamic micro-batcher: flush on max-batch-size or
+  max-delay, bounded queue with explicit backpressure.
+- ``server.py``  — in-process :class:`Client` plus a stdlib-HTTP front end
+  with latency/queue/occupancy metrics (obs/metrics.py ServeMetrics).
+
+Entry point: ``python -m distributed_tensorflow_tpu.cli.serve``.
+"""
+
+from distributed_tensorflow_tpu.serve.batcher import (  # noqa: F401
+    Backpressure,
+    BatcherConfig,
+    DynamicBatcher,
+)
+from distributed_tensorflow_tpu.serve.engine import (  # noqa: F401
+    BertInferenceEngine,
+    ImageClassifierEngine,
+    RequestError,
+)
+from distributed_tensorflow_tpu.serve.server import Client, build_http_server  # noqa: F401

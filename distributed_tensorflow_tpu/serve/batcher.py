@@ -1,0 +1,176 @@
+"""Dynamic micro-batcher: the queue between user requests and the engine.
+
+Semantics (the classic serving recipe, e.g. TF-Serving's BatchingSession —
+the piece the reference's train-only harness never had):
+
+- Requests enqueue with a ``Future``; a single flusher thread groups them.
+- A batch flushes when it reaches ``max_batch`` rows OR when the OLDEST
+  queued request has waited ``max_delay_ms`` — latency is bounded by the
+  deadline, throughput by the batch size, and the tradeoff is two knobs.
+- The queue is BOUNDED: past ``max_queue`` pending requests, ``submit``
+  raises :class:`Backpressure` with a retry-after hint. Overload degrades
+  to explicit rejection the client can retry, never to an unbounded queue
+  marching toward OOM.
+
+The batcher is engine-agnostic: ``run_batch(payloads) -> results`` is any
+callable (serve/engine.py provides the real ones; tests pass stubs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from collections.abc import Callable, Sequence
+from concurrent.futures import Future
+
+from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+
+
+class Backpressure(RuntimeError):
+    """Queue full — reject now, retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"request queue full; retry after {retry_after_s * 1e3:.0f} ms"
+        )
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    max_batch: int = 8          # flush when this many requests are queued
+    max_delay_ms: float = 8.0   # ...or when the oldest has waited this long
+    max_queue: int = 64         # bounded depth; beyond -> Backpressure
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_delay_ms < 0:
+            raise ValueError("max_delay_ms must be >= 0")
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+
+
+class _Pending:
+    __slots__ = ("payload", "future", "t_enqueue")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+
+
+class DynamicBatcher:
+    """Thread-safe request queue with size/deadline flushing.
+
+    ``run_batch`` runs on the flusher thread — one batch in flight at a
+    time, which is the right shape for a single-accelerator engine (the
+    executable is serial anyway) and keeps ordering deterministic.
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable[[list], Sequence],
+        config: BatcherConfig | None = None,
+        metrics: ServeMetrics | None = None,
+    ):
+        self.config = config or BatcherConfig()
+        self.metrics = metrics or ServeMetrics()
+        self._run_batch = run_batch
+        self._cv = threading.Condition()
+        self._queue: deque[_Pending] = deque()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, payload) -> Future:
+        """Enqueue one request; returns its Future (result = engine output).
+
+        Raises :class:`Backpressure` when the queue is at ``max_queue`` —
+        the retry-after hint is one max-delay window, the time one flush
+        takes to drain ``max_batch`` slots.
+        """
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._queue) >= self.config.max_queue:
+                self.metrics.rejected.inc()
+                # One flush window, floored at 1 ms so a zero-delay config
+                # still hands clients a usable (non-zero) retry hint.
+                raise Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
+            pending = _Pending(payload)
+            self._queue.append(pending)
+            self.metrics.requests.inc()
+            self.metrics.queue_depth.set(len(self._queue))
+            self._cv.notify_all()
+        return pending.future
+
+    def _take_batch(self) -> list[_Pending] | None:
+        """Block until a batch is due (size or deadline) or close drains."""
+        max_delay = self.config.max_delay_ms / 1e3
+        with self._cv:
+            while True:
+                if self._queue:
+                    if len(self._queue) >= self.config.max_batch or self._closed:
+                        break
+                    remaining = (
+                        self._queue[0].t_enqueue + max_delay - time.monotonic()
+                    )
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                elif self._closed:
+                    return None
+                else:
+                    self._cv.wait()
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(len(self._queue), self.config.max_batch))
+            ]
+            self.metrics.queue_depth.set(len(self._queue))
+            return batch
+
+    def _loop(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self.metrics.batches.inc()
+            self.metrics.batch_occupancy.observe(len(batch))
+            try:
+                results = self._run_batch([p.payload for p in batch])
+            except Exception as e:  # noqa: BLE001 — fail the batch, not the server
+                self.metrics.errors.inc()
+                for p in batch:
+                    if not p.future.cancelled():
+                        p.future.set_exception(e)
+                continue
+            now = time.monotonic()
+            for p, r in zip(batch, results):
+                self.metrics.latency.observe(now - p.t_enqueue)
+                if not p.future.cancelled():
+                    p.future.set_result(r)
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the flusher. ``drain=True`` serves what's queued first;
+        otherwise pending futures fail with a RuntimeError."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    p = self._queue.popleft()
+                    p.future.set_exception(RuntimeError("batcher closed"))
+            self._cv.notify_all()
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

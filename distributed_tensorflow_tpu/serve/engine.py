@@ -1,0 +1,321 @@
+"""Inference engines: checkpoint-loaded, mesh-sharded, AOT-compiled forwards.
+
+Design (the serving half of the training engine's "one trace, one
+executable" rule): every forward an engine will ever run is lowered and
+compiled at STARTUP — one executable per sequence bucket for BERT, one per
+image geometry for the classifiers — so no user request ever pays a trace
+or an XLA compile. Requests of arbitrary length pad up to the smallest
+bucket that fits (``BertInferenceEngine.buckets``, default {128, 256, 512}
+clamped to the model's ``max_position``); partial batches pad with inert
+rows to the fixed ``max_batch`` so the executable's shapes never vary.
+
+Placement mirrors training: params live replicated on the serving mesh
+(the DP-only analog of ``place_state``), batches shard their leading dim
+over the data axes when ``max_batch`` divides the DP width and fall back
+to replicated otherwise — a 7-row flush must degrade to redundant compute,
+never to a shape error.
+
+Checkpoints come from training via :func:`ckpt.restore_serving_state`: the
+template TrainState rebuilds the training structure, tensorstore reshards
+sharded arrays onto the serving mesh on read.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu.parallel.mesh import (
+    batch_sharding,
+    build_mesh,
+    data_axes,
+    replicated_sharding,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class RequestError(ValueError):
+    """A malformed or un-servable request (maps to HTTP 400, not 500)."""
+
+
+def _batch_sharding_or_replicated(mesh, max_batch: int):
+    """Shard the batch dim over the DP axes when the fixed batch divides the
+    DP width; otherwise serve replicated (small-batch engines on wide
+    meshes must work, just without the speedup)."""
+    n = math.prod(mesh.shape[a] for a in data_axes(mesh)) if data_axes(mesh) else 1
+    if n > 1 and max_batch % n == 0:
+        return batch_sharding(mesh)
+    if n > 1:
+        logger.info(
+            "serve batch %d not divisible by %d-way DP mesh; "
+            "replicating inference batches", max_batch, n,
+        )
+    return replicated_sharding(mesh)
+
+
+class _AotEngine:
+    """Shared AOT plumbing: compile-per-shape at startup, place-and-call."""
+
+    def __init__(self, mesh, max_batch: int):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.mesh = mesh if mesh is not None else build_mesh({"data": -1})
+        self.max_batch = max_batch
+        self._param_sharding = replicated_sharding(self.mesh)
+        self._batch_sharding = _batch_sharding_or_replicated(
+            self.mesh, max_batch
+        )
+
+    def _place(self, tree):
+        return jax.device_put(tree, self._param_sharding)
+
+    def _struct(self, shape, dtype):
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=self._batch_sharding
+        )
+
+    def _put(self, x):
+        return jax.device_put(x, self._batch_sharding)
+
+
+class BertInferenceEngine(_AotEngine):
+    """MLM scoring / masked-token prediction / sentence embedding over a
+    trained :class:`BertForPreTraining` checkpoint.
+
+    Request payload (numpy, one example per request):
+
+    - ``input_ids``: ``[l]`` int — already-tokenized ids, ``l`` <= the
+      largest bucket. Positions holding the MASK id are what
+      ``pred_ids`` answers for.
+    - ``token_type_ids``: optional ``[l]`` int (default zeros).
+    - ``mlm_targets``: optional ``[l]`` int, ``-1`` = unscored. When any
+      position is >= 0 the response carries ``score`` — the mean log-prob
+      of the targets (MLM pseudo-log-likelihood), the standard
+      BERT-as-scorer surface.
+
+    Response per request: ``pred_ids [l]`` (argmax token at every
+    position), ``score`` (float or None), ``embedding [H]`` (pooled [CLS]),
+    ``nsp_probs [2]``, ``bucket`` (the padded length actually run).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        mesh=None,
+        *,
+        buckets: tuple[int, ...] = (128, 256, 512),
+        max_batch: int = 8,
+        return_logits: bool = False,
+    ):
+        super().__init__(mesh, max_batch)
+        self.model = model
+        cfg = model.cfg
+        self.buckets = tuple(
+            sorted({min(int(b), cfg.max_position) for b in buckets})
+        )
+        if not self.buckets:
+            raise ValueError("need at least one sequence bucket")
+        self.return_logits = return_logits
+        self.params = self._place(params)
+        # AOT-compile one executable per bucket NOW: startup pays every
+        # trace/compile, the request path pays none (jit cache lookups
+        # included — these are Compiled objects, not jit wrappers).
+        self._compiled = {}
+        for L in self.buckets:
+            b = (self.max_batch, L)
+            self._compiled[L] = (
+                jax.jit(self._forward)
+                .lower(
+                    self.params,
+                    self._struct(b, jnp.int32),
+                    self._struct(b, jnp.bool_),
+                    self._struct(b, jnp.int32),
+                    self._struct(b, jnp.int32),
+                )
+                .compile()
+            )
+        logger.info(
+            "BERT engine ready: buckets=%s max_batch=%d (%d executables)",
+            self.buckets, self.max_batch, len(self._compiled),
+        )
+
+    def _forward(self, params, input_ids, attention_mask, token_type_ids,
+                 mlm_targets):
+        mlm_logits, nsp_logits, pooled = self.model.apply(
+            {"params": params},
+            input_ids,
+            attention_mask,
+            token_type_ids,
+            method="serve_outputs",
+        )
+        # Per-ROW MLM statistics, f32 on the fly from the storage dtype —
+        # the same masking/clamp recipe as the training loss (_mlm_stats),
+        # but without the cross-row reduction: serving scores examples.
+        weights = (mlm_targets >= 0).astype(jnp.float32)
+        m = jnp.max(mlm_logits, axis=-1, keepdims=True)
+        shifted = mlm_logits.astype(jnp.float32) - m.astype(jnp.float32)
+        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0].astype(
+            jnp.float32
+        )
+        tgt_logit = jnp.take_along_axis(
+            mlm_logits, jnp.maximum(mlm_targets, 0)[..., None], axis=-1
+        )[..., 0].astype(jnp.float32)
+        ce = (lse - tgt_logit) * weights
+        out = {
+            "pred_ids": jnp.argmax(mlm_logits, axis=-1).astype(jnp.int32),
+            "nll": jnp.sum(ce, axis=-1),
+            "count": jnp.sum(weights, axis=-1),
+            "embedding": pooled.astype(jnp.float32),
+            "nsp_probs": jax.nn.softmax(nsp_logits, axis=-1),
+        }
+        if self.return_logits:
+            out["mlm_logits"] = mlm_logits
+        return out
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise RequestError(
+            f"sequence length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    def validate(self, payload: dict) -> None:
+        """Reject un-servable payloads BEFORE they enqueue — a bad request
+        must fail alone, never poison the batch it would have ridden in."""
+        ids = np.asarray(payload.get("input_ids", ()))
+        if ids.ndim != 1 or ids.size == 0:
+            raise RequestError("input_ids must be a non-empty 1-D id list")
+        self.bucket_for(ids.shape[0])
+        for k in ("token_type_ids", "mlm_targets"):
+            if k in payload and np.asarray(payload[k]).shape != ids.shape:
+                raise RequestError(f"{k} shape must match input_ids")
+
+    def run_batch(self, payloads: list[dict]) -> list[dict]:
+        """Execute one micro-batch (the batcher's flush callback).
+
+        Pads every row to the batch's bucket — the smallest bucket holding
+        the LONGEST member (mixed-length batches pay the longest member's
+        bucket) — and pads missing rows to ``max_batch`` with inert rows
+        (mask True only at position 0: fully-masked rows would softmax
+        over zero keys; the padded rows' outputs are sliced off anyway,
+        but NaNs should never exist in a served buffer).
+        """
+        if len(payloads) > self.max_batch:
+            raise ValueError(
+                f"batch of {len(payloads)} exceeds max_batch {self.max_batch}"
+            )
+        lens = [np.asarray(p["input_ids"]).shape[0] for p in payloads]
+        L = self.bucket_for(max(lens))
+        B = self.max_batch
+        ids = np.zeros((B, L), np.int32)
+        mask = np.zeros((B, L), bool)
+        types = np.zeros((B, L), np.int32)
+        targets = np.full((B, L), -1, np.int32)
+        for r, (p, l) in enumerate(zip(payloads, lens)):
+            ids[r, :l] = np.asarray(p["input_ids"], np.int32)
+            mask[r, :l] = True
+            if "token_type_ids" in p:
+                types[r, :l] = np.asarray(p["token_type_ids"], np.int32)
+            if "mlm_targets" in p:
+                targets[r, :l] = np.asarray(p["mlm_targets"], np.int32)
+        mask[len(payloads):, 0] = True
+        out = self._compiled[L](
+            self.params,
+            self._put(ids),
+            self._put(mask),
+            self._put(types),
+            self._put(targets),
+        )
+        out = jax.device_get(out)
+        results = []
+        for r, l in enumerate(lens):
+            count = float(out["count"][r])
+            res = {
+                "pred_ids": out["pred_ids"][r, :l],
+                "score": (-float(out["nll"][r]) / count) if count else None,
+                "embedding": out["embedding"][r],
+                "nsp_probs": out["nsp_probs"][r],
+                "bucket": L,
+            }
+            if self.return_logits:
+                res["mlm_logits"] = out["mlm_logits"][r, :l]
+            results.append(res)
+        return results
+
+
+class ImageClassifierEngine(_AotEngine):
+    """Top-k classification over a trained image-classifier checkpoint
+    (LeNet/ResNet/Inception — anything with ``apply(vars, image,
+    train=False) -> logits``).
+
+    Request payload: ``image`` ``[H, W, C]`` float32 at the engine's
+    geometry (the model's training geometry — there is one image "bucket").
+    Response: ``top_ids [k]``, ``top_probs [k]``.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        model_state=None,
+        mesh=None,
+        *,
+        image_shape: tuple[int, int, int],
+        max_batch: int = 8,
+        top_k: int = 5,
+    ):
+        super().__init__(mesh, max_batch)
+        self.model = model
+        self.image_shape = tuple(image_shape)
+        self.top_k = top_k
+        self.variables = self._place(
+            {"params": params, **(model_state or {})}
+        )
+        self._compiled_fn = (
+            jax.jit(self._forward)
+            .lower(
+                self.variables,
+                self._struct((self.max_batch, *self.image_shape), jnp.float32),
+            )
+            .compile()
+        )
+        logger.info(
+            "image engine ready: shape=%s max_batch=%d top_k=%d",
+            self.image_shape, self.max_batch, top_k,
+        )
+
+    def _forward(self, variables, image):
+        logits = self.model.apply(variables, image, train=False)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        k = min(self.top_k, probs.shape[-1])
+        top_probs, top_ids = jax.lax.top_k(probs, k)
+        return {"top_ids": top_ids.astype(jnp.int32), "top_probs": top_probs}
+
+    def validate(self, payload: dict) -> None:
+        img = np.asarray(payload.get("image", ()))
+        if img.shape != self.image_shape:
+            raise RequestError(
+                f"image shape {img.shape} != engine geometry {self.image_shape}"
+            )
+
+    def run_batch(self, payloads: list[dict]) -> list[dict]:
+        if len(payloads) > self.max_batch:
+            raise ValueError(
+                f"batch of {len(payloads)} exceeds max_batch {self.max_batch}"
+            )
+        imgs = np.zeros((self.max_batch, *self.image_shape), np.float32)
+        for r, p in enumerate(payloads):
+            imgs[r] = np.asarray(p["image"], np.float32)
+        out = jax.device_get(self._compiled_fn(self.variables, self._put(imgs)))
+        return [
+            {"top_ids": out["top_ids"][r], "top_probs": out["top_probs"][r]}
+            for r in range(len(payloads))
+        ]

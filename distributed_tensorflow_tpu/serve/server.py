@@ -1,0 +1,168 @@
+"""Serving front ends: in-process :class:`Client` and a stdlib HTTP server.
+
+``Client`` is the canonical surface — validate-at-submit, enqueue into the
+:class:`DynamicBatcher`, block on the Future. The HTTP server is a thin
+JSON adapter over the same client (``ThreadingHTTPServer``: one thread per
+connection blocks on its Future while the flusher thread batches across
+them — exactly the concurrency the micro-batcher exists to exploit).
+
+Routes::
+
+    GET  /healthz    -> {"status": "ok", "engine": ...}
+    GET  /metrics    -> ServeMetrics.snapshot() as JSON
+    POST /v1/mlm     -> BERT: pred_ids / score / nsp_probs for one example
+    POST /v1/embed   -> BERT: pooled [CLS] embedding for one example
+    POST /v1/classify-> image: top-k ids/probs for one example
+
+Error mapping: RequestError -> 400; Backpressure -> 429 + ``Retry-After``;
+anything the engine raises mid-batch -> 500.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from concurrent.futures import Future
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.serve.batcher import (
+    BatcherConfig,
+    DynamicBatcher,
+)
+from distributed_tensorflow_tpu.serve.engine import RequestError
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    """In-process serving client: ``submit`` returns a Future, ``call``
+    blocks for the result. Payloads validate BEFORE they enqueue so a
+    malformed request fails alone instead of poisoning its batch."""
+
+    def __init__(
+        self,
+        engine,
+        config: BatcherConfig | None = None,
+        metrics: ServeMetrics | None = None,
+    ):
+        self.engine = engine
+        self.metrics = metrics or ServeMetrics()
+        if config is None:
+            config = BatcherConfig(max_batch=engine.max_batch)
+        elif config.max_batch > engine.max_batch:
+            raise ValueError(
+                f"batcher max_batch {config.max_batch} exceeds engine "
+                f"max_batch {engine.max_batch}"
+            )
+        self.batcher = DynamicBatcher(
+            engine.run_batch, config, metrics=self.metrics
+        )
+
+    def submit(self, payload: dict) -> Future:
+        self.engine.validate(payload)  # RequestError before enqueue
+        return self.batcher.submit(payload)
+
+    def call(self, payload: dict, timeout: float | None = 60.0) -> dict:
+        return self.submit(payload).result(timeout=timeout)
+
+    def close(self) -> None:
+        self.batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _jsonable(obj):
+    """numpy -> plain python, recursively (json.dumps chokes on ndarrays)."""
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
+def build_http_server(client: Client, host: str = "127.0.0.1", port: int = 0):
+    """Build (not start) a ``ThreadingHTTPServer`` over ``client``.
+
+    ``port=0`` binds an ephemeral port (tests read ``server.server_address``).
+    Call ``serve_forever()`` to run; ``shutdown()`` to stop.
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        # Route table maps a POST path to "which keys of the engine result
+        # this endpoint exposes" — both BERT routes run the SAME executable,
+        # /v1/embed just answers with less.
+        _routes = {
+            "/v1/mlm": ("pred_ids", "score", "nsp_probs", "bucket"),
+            "/v1/embed": ("embedding", "bucket"),
+            "/v1/classify": ("top_ids", "top_probs"),
+        }
+
+        def log_message(self, fmt, *args):  # route access logs into logging
+            logger.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, body: dict, headers: dict | None = None):
+            data = json.dumps(_jsonable(body)).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(
+                    200,
+                    {"status": "ok", "engine": type(client.engine).__name__},
+                )
+            elif self.path == "/metrics":
+                self._reply(200, client.metrics.snapshot())
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            fields = self._routes.get(self.path)
+            if fields is None:
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(payload, dict):
+                    raise RequestError("request body must be a JSON object")
+                result = client.call(payload)
+            except RequestError as e:
+                self._reply(400, {"error": str(e)})
+            except json.JSONDecodeError as e:
+                self._reply(400, {"error": f"bad JSON: {e}"})
+            except Exception as e:  # Backpressure or engine failure
+                retry = getattr(e, "retry_after_s", None)
+                if retry is not None:
+                    self._reply(
+                        429,
+                        {"error": str(e), "retry_after_s": retry},
+                        headers={"Retry-After": f"{retry:.3f}"},
+                    )
+                else:
+                    logger.exception("request failed")
+                    self._reply(500, {"error": str(e)})
+            else:
+                self._reply(
+                    200, {k: result[k] for k in fields if k in result}
+                )
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    logger.info("serving on http://%s:%d", *server.server_address)
+    return server

@@ -267,14 +267,21 @@ def make_train_step(
                 accum_body, init, (micro, jnp.arange(grad_accum))
             )
             inv = 1.0 / grad_accum
-            grads = jax.tree.map(lambda g: g * jnp.asarray(inv, g.dtype), g_sum)
+
+            def _slice_mean(leaf):
+                # Inexact leaves average in f32 (casting 1/ga to the leaf
+                # dtype would be fine for floats but ROUNDS TO ZERO for any
+                # integer leaf, silently zeroing it); integer leaves — e.g.
+                # a future count metric — stay as the accumulated SUM, the
+                # only mean-free reduction that keeps them meaningful.
+                if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    return leaf
+                return (leaf.astype(jnp.float32) * inv).astype(leaf.dtype)
+
+            grads = jax.tree.map(_slice_mean, g_sum)
             loss = l_sum * inv
-            model_state = jax.tree.map(
-                lambda s: s * jnp.asarray(inv, s.dtype), ms_sum
-            )
-            metrics = jax.tree.map(
-                lambda m: m * jnp.asarray(inv, m.dtype), m_sum
-            )
+            model_state = jax.tree.map(_slice_mean, ms_sum)
+            metrics = jax.tree.map(_slice_mean, m_sum)
         else:
             (loss, (model_state, metrics)), grads = grad_fn(
                 state.params, state.model_state, batch, rng

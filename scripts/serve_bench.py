@@ -1,0 +1,197 @@
+"""Serving latency/throughput vs offered load (the ISSUE acceptance bench).
+
+Open-loop load generator over the in-process serving stack: a tiny BERT
+engine (random init — this measures the SERVING machinery, not model
+quality; point --ckpt-dir at a real run to serve trained weights), the
+dynamic micro-batcher, and per-request latency measured enqueue→reply.
+
+Open-loop matters: requests arrive on a fixed schedule regardless of how
+fast replies come back, so queueing delay shows up in the tail instead of
+being hidden by a closed feedback loop. At each offered load the report
+gives achieved throughput, p50/p99 latency, mean batch occupancy (how well
+the batcher is packing the fixed-size executable), and the rejection count
+(backpressure engaging past saturation).
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py
+    python scripts/serve_bench.py --loads 100 400 1600 --duration 3
+    python scripts/serve_bench.py --json results.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_client(args):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.bert import (
+        BertConfig,
+        BertForPreTraining,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        BertInferenceEngine,
+        Client,
+    )
+
+    cfg = BertConfig(
+        vocab_size=args.vocab,
+        hidden_size=args.hidden,
+        num_layers=args.layers,
+        num_heads=max(2, args.hidden // 16),
+        intermediate_size=4 * args.hidden,
+        max_position=max(args.buckets),
+    )
+    model = BertForPreTraining(cfg)
+    L = cfg.max_position
+    variables = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )
+    params = variables["params"]
+    if args.ckpt_dir:
+        # Serve real weights: restore expects the training template; the
+        # bench only rebuilds bare params, so accept plain-SGD runs here.
+        import optax
+
+        from distributed_tensorflow_tpu.ckpt import restore_serving_state
+        from distributed_tensorflow_tpu.train import create_train_state
+
+        template = create_train_state(params, optax.sgd(0.1), {})
+        params, _, step = restore_serving_state(args.ckpt_dir, template)
+        print(f"# serving checkpoint step {step} from {args.ckpt_dir}")
+
+    engine = BertInferenceEngine(
+        model, params, buckets=tuple(args.buckets), max_batch=args.max_batch
+    )
+    client = Client(
+        engine,
+        BatcherConfig(
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
+        ),
+    )
+    return client, cfg.vocab_size
+
+
+def make_payloads(vocab: int, buckets, n: int = 256) -> list[dict]:
+    """Pre-generated request pool (generation must not gate the load loop)."""
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        l = int(rng.integers(8, max(buckets) + 1))
+        ids = rng.integers(5, vocab, size=l)
+        out.append({"input_ids": ids, "mlm_targets": ids})
+    return out
+
+
+def run_load(client, payloads, offered_rps: float, duration_s: float) -> dict:
+    """Open-loop: submit on schedule, never wait for replies in the loop."""
+    from distributed_tensorflow_tpu.serve import Backpressure
+
+    interval = 1.0 / offered_rps
+    futures, rejected = [], 0
+    t0 = time.monotonic()
+    n = int(offered_rps * duration_s)
+    for i in range(n):
+        target = t0 + i * interval
+        now = time.monotonic()
+        if target > now:
+            time.sleep(target - now)
+        t_sub = time.monotonic()
+        try:
+            futures.append((t_sub, client.submit(payloads[i % len(payloads)])))
+        except Backpressure:
+            rejected += 1
+    # Latency comes from the batcher's own enqueue→reply histogram, not
+    # this collection loop (which would add collector skew).
+    for _, f in futures:
+        f.result(timeout=120)
+    t_end = time.monotonic()
+    served = len(futures)
+    return {
+        "offered_rps": offered_rps,
+        "submitted": n,
+        "served": served,
+        "rejected": rejected,
+        "achieved_rps": served / (t_end - t0),
+        "wall_s": t_end - t0,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--loads", type=float, nargs="+", default=[50.0, 200.0],
+                   help="offered loads in requests/second (>=2 for the sweep)")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds per offered-load point")
+    p.add_argument("--buckets", type=int, nargs="+", default=[32, 64, 128])
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-delay-ms", type=float, default=8.0)
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--ckpt-dir", default="",
+                   help="serve a real checkpoint instead of random init")
+    p.add_argument("--json", default="", help="also write results here")
+    args = p.parse_args(argv)
+
+    client, vocab = build_client(args)
+    payloads = make_payloads(vocab, args.buckets)
+
+    # Warmup: fill every bucket's executable path + the thread machinery.
+    for f in [client.submit(payloads[i]) for i in range(16)]:
+        f.result(timeout=120)
+
+    rows = []
+    try:
+        for rps in args.loads:
+            # Per-point metrics: fresh histograms so p99 is per-load.
+            client.metrics.latency.reset()
+            client.metrics.batch_occupancy.reset()
+            r = run_load(client, payloads, rps, args.duration)
+            snap = client.metrics.snapshot()
+            r["p50_ms"] = snap["latency_ms"]["p50"]
+            r["p99_ms"] = snap["latency_ms"]["p99"]
+            r["mean_batch_occupancy"] = snap["batch_occupancy"]["mean"]
+            rows.append(r)
+    finally:
+        client.close()
+
+    hdr = (
+        f"{'offered rps':>12} {'achieved rps':>13} {'served':>7} "
+        f"{'rejected':>9} {'p50 ms':>8} {'p99 ms':>8} {'occupancy':>10}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(
+            f"{r['offered_rps']:>12.1f} {r['achieved_rps']:>13.1f} "
+            f"{r['served']:>7d} {r['rejected']:>9d} "
+            f"{r['p50_ms']:>8.2f} {r['p99_ms']:>8.2f} "
+            f"{r['mean_batch_occupancy']:>10.2f}"
+        )
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(rows, fh, indent=2)
+        print(f"# wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

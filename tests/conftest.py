@@ -8,14 +8,28 @@ every collective/sharding test runs in CI on CPU.
 Must run before any ``import jax`` in the test session, hence conftest.
 """
 
+import os
+
 import jax
 
 # The environment pre-imports jax at interpreter startup (TPU platform
 # plugin), so JAX_PLATFORMS/XLA_FLAGS env vars are too late — set the config
-# directly before the first backend touch.
+# directly before the first backend touch. Older jax (< 0.5) has no
+# jax_num_cpu_devices option; there the XLA flag still lands in time
+# because the CPU backend only reads it at first device touch.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 jax.config.update("jax_threefry_partitionable", True)
+
+# Install the jax version shims (jax.shard_map / lax.axis_size on 0.4.x)
+# before any test module's top-level `from jax import shard_map`.
+import distributed_tensorflow_tpu.compat  # noqa: E402,F401
 
 import pytest  # noqa: E402
 

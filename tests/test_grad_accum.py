@@ -137,8 +137,13 @@ def test_grad_accum_bert_ratio_loss(devices8):
     plain, accum = run(1), run(2)
     # Step-1 losses use identical params: ratio vs mean-of-ratios only.
     np.testing.assert_allclose(plain[0], accum[0], rtol=0.05)
-    # Both converge to the same neighborhood.
-    assert np.mean(accum[-5:]) < np.mean(accum[:5]) * 0.95
+    # The accumulated run must TRACK the plain run step for step: mean-of-
+    # ratios vs full-batch ratio is the only divergence source, and on the
+    # synthetic stream (iid masked counts per slice) it stays at rounding
+    # scale. This is tight AND environment-independent, unlike an absolute
+    # convergence threshold (the tiny model's 30-step drop varies by
+    # platform — ADVICE.md round 5).
+    np.testing.assert_allclose(plain, accum, rtol=0.02)
     np.testing.assert_allclose(
         np.mean(plain[-5:]), np.mean(accum[-5:]), rtol=0.1
     )

@@ -1,0 +1,535 @@
+"""Serving subsystem tests: batcher semantics, engine bucketing, HTTP front
+end, and the train -> checkpoint -> serve round trip.
+
+The batcher and HTTP tests run against stub engines (pure-python, no JAX) —
+they pin the QUEUEING semantics: flush-on-size, flush-on-deadline, bounded
+queue with backpressure, batch-failure isolation. The round-trip test is the
+acceptance check: logits served through the full stack (restore -> AOT
+executable -> pad-to-bucket -> batcher) equal a direct forward of the same
+padded batch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.serve import (
+    Backpressure,
+    BatcherConfig,
+    Client,
+    DynamicBatcher,
+    RequestError,
+    build_http_server,
+)
+
+# ---------------------------------------------------------------- batcher
+
+
+def _echo(payloads):
+    return [{"v": p} for p in payloads]
+
+
+def test_batcher_flushes_on_size():
+    """max_batch queued requests flush immediately — no deadline wait."""
+    sizes = []
+
+    def run(payloads):
+        sizes.append(len(payloads))
+        return _echo(payloads)
+
+    with DynamicBatcher(
+        run, BatcherConfig(max_batch=4, max_delay_ms=10_000.0)
+    ) as b:
+        t0 = time.monotonic()
+        futs = [b.submit(i) for i in range(4)]
+        results = [f.result(timeout=5) for f in futs]
+        elapsed = time.monotonic() - t0
+    assert [r["v"] for r in results] == [0, 1, 2, 3]
+    assert sizes == [4]
+    # Far below the 10s deadline: the size trigger fired, not the timer.
+    assert elapsed < 5.0
+
+
+def test_batcher_flushes_on_deadline():
+    """A partial batch flushes once the oldest request ages past max_delay."""
+    sizes = []
+
+    def run(payloads):
+        sizes.append(len(payloads))
+        return _echo(payloads)
+
+    with DynamicBatcher(
+        run, BatcherConfig(max_batch=8, max_delay_ms=30.0)
+    ) as b:
+        t0 = time.monotonic()
+        f = b.submit("only")
+        assert f.result(timeout=5) == {"v": "only"}
+        elapsed = time.monotonic() - t0
+    assert sizes == [1]
+    assert elapsed >= 0.025  # waited for the deadline, not forever
+
+
+def test_batcher_backpressure_bounded_queue():
+    """Past max_queue pending requests, submit raises Backpressure with a
+    retry-after hint; draining the queue re-admits requests."""
+    release = threading.Event()
+
+    def slow(payloads):
+        release.wait(timeout=10)
+        return _echo(payloads)
+
+    m = ServeMetrics()
+    b = DynamicBatcher(
+        slow, BatcherConfig(max_batch=1, max_delay_ms=0.0, max_queue=2), m
+    )
+    try:
+        first = b.submit("in-flight")  # popped by the flusher, blocks in run
+        time.sleep(0.05)  # let the flusher take it off the queue
+        queued = [b.submit(i) for i in range(2)]  # fills the bounded queue
+        with pytest.raises(Backpressure) as ei:
+            b.submit("overflow")
+        assert ei.value.retry_after_s > 0
+        assert m.rejected.value == 1
+        release.set()
+        assert first.result(timeout=5) == {"v": "in-flight"}
+        assert [f.result(timeout=5)["v"] for f in queued] == [0, 1]
+        # After draining, the queue admits again.
+        assert b.submit("again").result(timeout=5) == {"v": "again"}
+    finally:
+        release.set()
+        b.close()
+
+
+def test_batcher_batch_failure_is_isolated():
+    """A raising run_batch fails that batch's futures; the flusher thread
+    survives and the next batch serves normally."""
+    fail = {"on": True}
+
+    def run(payloads):
+        if fail["on"]:
+            raise RuntimeError("engine exploded")
+        return _echo(payloads)
+
+    m = ServeMetrics()
+    with DynamicBatcher(
+        run, BatcherConfig(max_batch=2, max_delay_ms=5.0), m
+    ) as b:
+        bad = [b.submit(i) for i in range(2)]
+        for f in bad:
+            with pytest.raises(RuntimeError, match="engine exploded"):
+                f.result(timeout=5)
+        fail["on"] = False
+        ok = [b.submit(i) for i in range(2)]
+        assert [f.result(timeout=5)["v"] for f in ok] == [0, 1]
+    assert m.errors.value == 1
+    assert m.batches.value == 2
+
+
+def test_batcher_close_drains_queue():
+    served = []
+
+    def run(payloads):
+        served.extend(payloads)
+        return _echo(payloads)
+
+    b = DynamicBatcher(run, BatcherConfig(max_batch=2, max_delay_ms=10_000.0))
+    f = b.submit("pending")  # deadline far away: only close() can flush it
+    b.close(drain=True)
+    assert f.result(timeout=1) == {"v": "pending"}
+    assert served == ["pending"]
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit("late")
+
+
+def test_batcher_metrics_occupancy_and_latency():
+    m = ServeMetrics()
+    with DynamicBatcher(
+        _echo, BatcherConfig(max_batch=4, max_delay_ms=5.0), m
+    ) as b:
+        for f in [b.submit(i) for i in range(6)]:
+            f.result(timeout=5)
+    snap = m.snapshot()
+    assert snap["requests"] == 6
+    assert snap["latency_ms"]["count"] == 6
+    assert snap["latency_ms"]["p99"] >= snap["latency_ms"]["p50"] > 0
+    # 6 requests over max_batch=4 -> a full batch plus a partial.
+    assert snap["batch_occupancy"]["count"] >= 2
+    assert snap["batch_occupancy"]["max"] <= 4
+
+
+# ----------------------------------------------------- HTTP front end (stub)
+
+
+class _StubEngine:
+    """Pure-python engine: pins the HTTP layer without touching JAX."""
+
+    max_batch = 4
+
+    def validate(self, payload):
+        if "input_ids" not in payload:
+            raise RequestError("input_ids required")
+
+    def run_batch(self, payloads):
+        return [
+            {
+                "pred_ids": np.asarray(p["input_ids"], np.int32),
+                "score": -1.5,
+                "nsp_probs": np.array([0.25, 0.75], np.float32),
+                "embedding": np.zeros(4, np.float32),
+                "bucket": 16,
+            }
+            for p in payloads
+        ]
+
+
+@pytest.fixture()
+def http_server():
+    client = Client(_StubEngine(), BatcherConfig(max_batch=4, max_delay_ms=2.0))
+    server = build_http_server(client, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host, port = server.server_address
+    yield f"http://{host}:{port}", client
+    server.shutdown()
+    server.server_close()
+    client.close()
+    thread.join(timeout=5)
+
+
+def _post(url, body: dict):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_health_metrics_and_mlm(http_server):
+    base, _ = http_server
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        assert r.status == 200
+        assert json.loads(r.read())["status"] == "ok"
+
+    status, body = _post(base + "/v1/mlm", {"input_ids": [3, 5, 7]})
+    assert status == 200
+    assert body["pred_ids"] == [3, 5, 7]
+    assert body["score"] == -1.5
+    assert body["nsp_probs"] == [0.25, 0.75]
+    assert "embedding" not in body  # /v1/mlm does not expose the embedding
+
+    status, body = _post(base + "/v1/embed", {"input_ids": [1]})
+    assert status == 200
+    assert body["embedding"] == [0.0, 0.0, 0.0, 0.0]
+
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        snap = json.loads(r.read())
+    assert snap["requests"] == 2
+    assert snap["latency_ms"]["count"] == 2
+
+
+def test_http_error_mapping(http_server):
+    base, _ = http_server
+    # Malformed request -> 400 (RequestError from validate, pre-enqueue).
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/v1/mlm", {"wrong": 1})
+    assert ei.value.code == 400
+    assert "input_ids" in json.loads(ei.value.read())["error"]
+    # Bad JSON -> 400.
+    req = urllib.request.Request(base + "/v1/mlm", data=b"{not json")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    # Unknown route -> 404.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + "/v1/nope", {})
+    assert ei.value.code == 404
+
+
+def test_http_backpressure_maps_to_429():
+    release = threading.Event()
+
+    class Blocking(_StubEngine):
+        def run_batch(self, payloads):
+            release.wait(timeout=10)
+            return super().run_batch(payloads)
+
+    client = Client(
+        Blocking(),
+        BatcherConfig(max_batch=1, max_delay_ms=0.0, max_queue=1),
+    )
+    server = build_http_server(client, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = "http://{}:{}".format(*server.server_address)
+    try:
+        inflight = client.submit({"input_ids": [1]})  # flusher takes it
+        time.sleep(0.05)
+        queued = client.submit({"input_ids": [2]})  # fills max_queue=1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + "/v1/mlm", {"input_ids": [3]})
+        assert ei.value.code == 429
+        assert float(ei.value.headers["Retry-After"]) >= 0
+        release.set()
+        inflight.result(timeout=5)
+        queued.result(timeout=5)
+    finally:
+        release.set()
+        server.shutdown()
+        server.server_close()
+        client.close()
+        thread.join(timeout=5)
+
+
+# ------------------------------------------------- engine + round trip (JAX)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_engine(devices8):
+    """Random-init tiny BERT engine (module-scoped: compiles once)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.bert import (
+        BertConfig,
+        BertForPreTraining,
+    )
+    from distributed_tensorflow_tpu.serve import BertInferenceEngine
+
+    cfg = BertConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=1,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=32,
+    )
+    model = BertForPreTraining(cfg)
+    L = cfg.max_position
+    variables = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )
+    return BertInferenceEngine(
+        model, variables["params"], buckets=(16, 32), max_batch=4
+    )
+
+
+def test_engine_bucket_selection(tiny_bert_engine):
+    eng = tiny_bert_engine
+    assert eng.bucket_for(1) == 16
+    assert eng.bucket_for(16) == 16
+    assert eng.bucket_for(17) == 32
+    with pytest.raises(RequestError, match="exceeds the largest bucket"):
+        eng.bucket_for(33)
+    # Buckets wider than max_position clamp to it (and dedupe).
+    assert eng.buckets == (16, 32)
+
+
+def test_engine_validate_rejects_bad_payloads(tiny_bert_engine):
+    eng = tiny_bert_engine
+    with pytest.raises(RequestError, match="non-empty"):
+        eng.validate({"input_ids": []})
+    with pytest.raises(RequestError, match="non-empty"):
+        eng.validate({"input_ids": [[1, 2]]})
+    with pytest.raises(RequestError, match="exceeds"):
+        eng.validate({"input_ids": list(range(40))})
+    with pytest.raises(RequestError, match="mlm_targets"):
+        eng.validate({"input_ids": [1, 2, 3], "mlm_targets": [1]})
+
+
+def test_engine_mixed_lengths_pad_to_longest_bucket(tiny_bert_engine):
+    rng = np.random.default_rng(0)
+    payloads = [
+        {"input_ids": rng.integers(5, 64, size=l)} for l in (4, 10, 20)
+    ]
+    results = tiny_bert_engine.run_batch(payloads)
+    # Longest member (20) sets the bucket for everyone.
+    assert [r["bucket"] for r in results] == [32, 32, 32]
+    for p, r in zip(payloads, results):
+        assert r["pred_ids"].shape == p["input_ids"].shape
+        assert r["score"] is None  # no mlm_targets -> unscored
+        np.testing.assert_allclose(np.sum(r["nsp_probs"]), 1.0, rtol=1e-5)
+
+
+def test_engine_results_independent_of_batchmates(tiny_bert_engine):
+    """The same request served alone and in a full batch answers the same —
+    padding rows and batchmates must not leak into a row's outputs."""
+    rng = np.random.default_rng(1)
+    ids = rng.integers(5, 64, size=12)
+    solo = tiny_bert_engine.run_batch(
+        [{"input_ids": ids, "mlm_targets": ids}]
+    )[0]
+    crowd = tiny_bert_engine.run_batch(
+        [{"input_ids": ids, "mlm_targets": ids}]
+        + [{"input_ids": rng.integers(5, 64, size=9)} for _ in range(3)]
+    )[0]
+    np.testing.assert_array_equal(solo["pred_ids"], crowd["pred_ids"])
+    np.testing.assert_allclose(solo["score"], crowd["score"], rtol=1e-5)
+    np.testing.assert_allclose(
+        solo["embedding"], crowd["embedding"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_client_end_to_end(tiny_bert_engine):
+    rng = np.random.default_rng(2)
+    with Client(
+        tiny_bert_engine, BatcherConfig(max_batch=4, max_delay_ms=2.0)
+    ) as client:
+        futs = [
+            client.submit(
+                {"input_ids": rng.integers(5, 64, size=int(rng.integers(4, 30)))}
+            )
+            for _ in range(10)
+        ]
+        results = [f.result(timeout=60) for f in futs]
+        # Validation failures surface at submit, before the queue.
+        with pytest.raises(RequestError):
+            client.submit({"input_ids": []})
+    assert len(results) == 10
+    assert all(r["bucket"] in (16, 32) for r in results)
+    snap = client.metrics.snapshot()
+    assert snap["requests"] == 10 and snap["errors"] == 0
+
+
+# The acceptance round trip: a checkpoint written by the real training CLI,
+# restored through the serving path, must answer with the training model's
+# exact logits.
+
+_TINY_BERT_FLAGS = [
+    "--bert-layers=1",
+    "--bert-hidden=32",
+    "--bert-vocab=64",
+]
+
+
+@pytest.fixture(scope="module")
+def trained_bert_ckpt(tmp_path_factory, devices8):
+    from distributed_tensorflow_tpu.cli.train import main as train_main
+
+    ckpt_dir = tmp_path_factory.mktemp("serve_ckpt") / "ck"
+    rc = train_main(
+        [
+            "--config=bert_base",
+            "--steps=2",
+            "--global-batch=8",
+            "--log-every=1",
+            f"--ckpt-dir={ckpt_dir}",
+            *_TINY_BERT_FLAGS,
+        ]
+    )
+    assert rc == 0
+    return ckpt_dir
+
+
+def test_train_checkpoint_serve_round_trip(trained_bert_ckpt):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.ckpt import restore_serving_state
+    from distributed_tensorflow_tpu.cli.train import PRESETS, _make_tx
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.serve import BertInferenceEngine
+    from distributed_tensorflow_tpu.train import create_train_state
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    cfg = dataclasses.replace(
+        PRESETS["bert_base"], bert_layers=1, bert_hidden=32, bert_vocab=64
+    )
+    mesh = build_mesh({"data": -1})
+    pieces = cfg.build(cfg)(mesh)
+    tx, _ = _make_tx(cfg)
+    template = place_state(
+        create_train_state(pieces["params"], tx, pieces["model_state"]),
+        mesh,
+        None,
+    )
+    params, _, step = restore_serving_state(trained_bert_ckpt, template)
+    assert step == 2
+    # Trained weights, not the template's init (the embedding moved).
+    init_emb = jax.device_get(
+        pieces["params"]["bert"]["embeddings"]["word"]["embedding"]
+    )
+    ckpt_emb = jax.device_get(
+        params["bert"]["embeddings"]["word"]["embedding"]
+    )
+    assert np.abs(np.asarray(init_emb, np.float32)
+                  - np.asarray(ckpt_emb, np.float32)).max() > 0
+
+    model = pieces["model"]
+    engine = BertInferenceEngine(
+        model, params, mesh, buckets=(16,), max_batch=2, return_logits=True
+    )
+    rng = np.random.default_rng(3)
+    ids = rng.integers(5, 64, size=11)
+    served = engine.run_batch([{"input_ids": ids, "mlm_targets": ids}])[0]
+
+    # Direct forward of the SAME padded batch the engine ran: row 0 real,
+    # row 1 the inert pad row (mask true only at position 0).
+    B, L = 2, 16
+    pid = np.zeros((B, L), np.int32)
+    pmask = np.zeros((B, L), bool)
+    ptype = np.zeros((B, L), np.int32)
+    pid[0, :11] = ids
+    pmask[0, :11] = True
+    pmask[1, 0] = True
+    mlm_logits, nsp_logits, pooled = jax.jit(
+        lambda p, i, m, t: model.apply(
+            {"params": p}, i, m, t, method="serve_outputs"
+        )
+    )(params, pid, pmask, ptype)
+    direct = np.asarray(jax.device_get(mlm_logits)[0, :11], np.float32)
+    got = np.asarray(served["mlm_logits"], np.float32)
+    # bf16 model: both paths compute in bf16; XLA fusion may round
+    # differently between the two compilations, so float tolerance, not
+    # bit equality.
+    np.testing.assert_allclose(got, direct, rtol=2e-2, atol=2e-2)
+    np.testing.assert_array_equal(
+        served["pred_ids"], np.argmax(direct, axis=-1)
+    )
+    np.testing.assert_allclose(
+        served["embedding"],
+        np.asarray(jax.device_get(pooled)[0], np.float32),
+        rtol=2e-2,
+        atol=2e-2,
+    )
+    np.testing.assert_allclose(
+        served["nsp_probs"],
+        jax.nn.softmax(
+            np.asarray(jax.device_get(nsp_logits)[0], np.float32)
+        ),
+        rtol=2e-2,
+        atol=2e-2,
+    )
+
+
+def test_cli_serve_selftest(trained_bert_ckpt):
+    """The serve entrypoint answers synthetic requests from a real ckpt."""
+    from distributed_tensorflow_tpu.cli.serve import main as serve_main
+
+    rc = serve_main(
+        [
+            "--config=bert_base",
+            f"--ckpt-dir={trained_bert_ckpt}",
+            *_TINY_BERT_FLAGS,
+            "--buckets", "16", "32",
+            "--max-batch=2",
+            "--max-delay-ms=2",
+            "--selftest=3",
+        ]
+    )
+    assert rc == 0
