@@ -77,6 +77,7 @@ def build_serving_client(cfg, args):
             mesh,
             image_shape=shape,
             max_batch=args.max_batch,
+            batch_tiers=tuple(args.batch_tiers),
             top_k=args.top_k,
         )
 
@@ -90,6 +91,7 @@ def build_serving_client(cfg, args):
             mesh,
             buckets=tuple(args.buckets),
             max_batch=args.max_batch,
+            batch_tiers=tuple(args.batch_tiers),
         )
         vocab = pieces["model"].cfg.vocab_size
 
@@ -104,6 +106,8 @@ def build_serving_client(cfg, args):
             max_batch=args.max_batch,
             max_delay_ms=args.max_delay_ms,
             max_queue=args.max_queue,
+            max_in_flight=args.max_in_flight,
+            bucket_queues=args.bucket_queues,
         ),
         metrics=metrics,
     )
@@ -138,7 +142,19 @@ def main(argv: list[str] | None = None):
                         help="sequence-length buckets (clamped to the "
                         "model's max_position); one executable each")
     parser.add_argument("--max-batch", type=int, default=8,
-                        help="fixed executable batch size / flush size")
+                        help="largest executable batch size / flush size")
+    parser.add_argument("--batch-tiers", type=int, nargs="+",
+                        default=[1, 2, 4, 8],
+                        help="batch-size tiers to AOT-compile (clamped to "
+                        "--max-batch); a partial flush runs the smallest "
+                        "tier that fits instead of padding to max-batch")
+    parser.add_argument("--max-in-flight", type=int, default=2,
+                        help="batches dispatched but not yet fetched; >1 "
+                        "overlaps host assembly with device compute")
+    parser.add_argument("--bucket-queues", action="store_true",
+                        help="queue per sequence bucket so short requests "
+                        "flush together instead of padding to a long "
+                        "batchmate's bucket")
     parser.add_argument("--max-delay-ms", type=float, default=8.0,
                         help="flush a partial batch after this wait")
     parser.add_argument("--max-queue", type=int, default=64,

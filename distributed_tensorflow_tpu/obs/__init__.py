@@ -13,6 +13,8 @@ from distributed_tensorflow_tpu.obs.metrics import (  # noqa: F401
     Gauge,
     Histogram,
     JsonlWriter,
+    LabelledCounter,
+    LabelledHistogram,
     ServeMetrics,
     TensorBoardWriter,
     make_metric_hook,

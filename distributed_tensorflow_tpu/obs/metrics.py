@@ -148,6 +148,52 @@ class Histogram:
         }
 
 
+class LabelledCounter:
+    """Thread-safe counter family keyed by label (per-tier / per-bucket
+    hit counts). Labels are created on first ``inc``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals: dict = {}
+
+    def inc(self, label, n: int = 1) -> None:
+        with self._lock:
+            self._vals[label] = self._vals.get(label, 0) + n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {str(k): v for k, v in sorted(self._vals.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._vals.clear()
+
+
+class LabelledHistogram:
+    """Thread-safe histogram family keyed by label (per-tier occupancy)."""
+
+    def __init__(self, max_samples: int = 2048):
+        self._lock = threading.Lock()
+        self._max_samples = max_samples
+        self._hists: dict = {}
+
+    def observe(self, label, v: float) -> None:
+        with self._lock:
+            h = self._hists.get(label)
+            if h is None:
+                h = self._hists[label] = Histogram(self._max_samples)
+        h.observe(v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            hists = dict(self._hists)
+        return {str(k): h.summary() for k, h in sorted(hists.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hists.clear()
+
+
 class ServeMetrics:
     """The serving subsystem's observability bundle (serve/batcher.py wires
     it; serve/server.py exposes it as JSON at ``GET /metrics``)."""
@@ -156,10 +202,15 @@ class ServeMetrics:
         self.latency = Histogram()          # seconds, submit -> reply
         self.batch_occupancy = Histogram()  # rows per flushed batch
         self.queue_depth = Gauge()
+        self.in_flight = Gauge()            # dispatched-not-yet-fetched batches
         self.requests = Counter()
         self.rejected = Counter()           # backpressure rejections
         self.batches = Counter()
         self.errors = Counter()             # batches that raised
+        self.padded_rows = Counter()        # wasted executable rows (tier - occupancy)
+        self.tier_hits = LabelledCounter()      # dispatches per batch tier
+        self.bucket_hits = LabelledCounter()    # dispatches per sequence bucket
+        self.tier_occupancy = LabelledHistogram()  # rows per dispatch, by tier
 
     def snapshot(self) -> dict:
         lat = self.latency.summary()
@@ -169,10 +220,15 @@ class ServeMetrics:
             "batches": self.batches.value,
             "errors": self.errors.value,
             "queue_depth": self.queue_depth.value,
+            "in_flight": self.in_flight.value,
+            "padded_rows": self.padded_rows.value,
             "latency_ms": {
                 k: (v * 1e3 if k != "count" else v) for k, v in lat.items()
             },
             "batch_occupancy": self.batch_occupancy.summary(),
+            "tier_hits": self.tier_hits.snapshot(),
+            "bucket_hits": self.bucket_hits.snapshot(),
+            "tier_occupancy": self.tier_occupancy.snapshot(),
         }
 
 

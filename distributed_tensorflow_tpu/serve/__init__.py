@@ -6,10 +6,12 @@ same sharded-model, checkpoint, and observability infrastructure as
 training rather than a separate stack:
 
 - ``engine.py``  — checkpoint-loading, mesh-sharded, AOT-compiled forward
-  engines with sequence-length bucketing (one executable per bucket built
-  at startup, so no request ever pays a trace).
+  engines with a batch-tier x sequence-bucket executable grid (all built
+  at startup, so no request ever pays a trace) and a non-blocking
+  ``dispatch``/``fetch`` split over reusable staging buffers.
 - ``batcher.py`` — dynamic micro-batcher: flush on max-batch-size or
-  max-delay, bounded queue with explicit backpressure.
+  max-delay, bounded queue with explicit backpressure, optional
+  per-bucket queues, and up to ``max_in_flight`` overlapped batches.
 - ``server.py``  — in-process :class:`Client` plus a stdlib-HTTP front end
   with latency/queue/occupancy metrics (obs/metrics.py ServeMetrics).
 
@@ -24,6 +26,7 @@ from distributed_tensorflow_tpu.serve.batcher import (  # noqa: F401
 from distributed_tensorflow_tpu.serve.engine import (  # noqa: F401
     BertInferenceEngine,
     ImageClassifierEngine,
+    InFlightBatch,
     RequestError,
 )
 from distributed_tensorflow_tpu.serve.server import Client, build_http_server  # noqa: F401

@@ -3,7 +3,7 @@
 Semantics (the classic serving recipe, e.g. TF-Serving's BatchingSession —
 the piece the reference's train-only harness never had):
 
-- Requests enqueue with a ``Future``; a single flusher thread groups them.
+- Requests enqueue with a ``Future``; a flusher thread groups them.
 - A batch flushes when it reaches ``max_batch`` rows OR when the OLDEST
   queued request has waited ``max_delay_ms`` — latency is bounded by the
   deadline, throughput by the batch size, and the tradeoff is two knobs.
@@ -11,14 +11,27 @@ the piece the reference's train-only harness never had):
   raises :class:`Backpressure` with a retry-after hint. Overload degrades
   to explicit rejection the client can retry, never to an unbounded queue
   marching toward OOM.
+- Optional BUCKET-AWARE queues (``bucket_for``): requests group per
+  engine bucket so short requests flush together instead of riding a
+  long batchmate's padded bucket. Deadline semantics stay global (the
+  flusher always waits on the globally-oldest request, then flushes its
+  bucket) and the ``max_queue`` bound counts ALL buckets together.
+- Optional OVERLAPPED dispatch (``dispatch``/``fetch``): when the engine
+  splits its hot path, the flusher thread only assembles and launches —
+  a separate completion thread blocks on ``fetch`` — so up to
+  ``max_in_flight`` batches pipeline host assembly against device
+  compute. Results deliver in dispatch order (FIFO completion queue).
 
 The batcher is engine-agnostic: ``run_batch(payloads) -> results`` is any
-callable (serve/engine.py provides the real ones; tests pass stubs).
+callable (serve/engine.py provides the real ones; tests pass stubs), and
+the overlap/bucket hooks are optional keyword callables.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
+import queue
 import threading
 import time
 from collections import deque
@@ -26,6 +39,8 @@ from collections.abc import Callable, Sequence
 from concurrent.futures import Future
 
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+
+logger = logging.getLogger(__name__)
 
 
 class Backpressure(RuntimeError):
@@ -43,6 +58,9 @@ class BatcherConfig:
     max_batch: int = 8          # flush when this many requests are queued
     max_delay_ms: float = 8.0   # ...or when the oldest has waited this long
     max_queue: int = 64         # bounded depth; beyond -> Backpressure
+    max_in_flight: int = 2      # dispatched-not-fetched batches (needs an
+                                # engine with dispatch/fetch; else 1)
+    bucket_queues: bool = False  # per-bucket queues (needs bucket_for)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -51,6 +69,10 @@ class BatcherConfig:
             raise ValueError("max_delay_ms must be >= 0")
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_in_flight < 1:
+            raise ValueError(
+                f"max_in_flight must be >= 1, got {self.max_in_flight}"
+            )
 
 
 class _Pending:
@@ -65,9 +87,10 @@ class _Pending:
 class DynamicBatcher:
     """Thread-safe request queue with size/deadline flushing.
 
-    ``run_batch`` runs on the flusher thread — one batch in flight at a
-    time, which is the right shape for a single-accelerator engine (the
-    executable is serial anyway) and keeps ordering deterministic.
+    Without ``dispatch``/``fetch``, ``run_batch`` runs on the flusher
+    thread — one batch in flight at a time, the right shape for an engine
+    that blocks anyway. With them, the flusher assembles+launches and a
+    completion thread fetches, bounded by ``config.max_in_flight``.
     """
 
     def __init__(
@@ -75,13 +98,35 @@ class DynamicBatcher:
         run_batch: Callable[[list], Sequence],
         config: BatcherConfig | None = None,
         metrics: ServeMetrics | None = None,
+        *,
+        dispatch: Callable | None = None,
+        fetch: Callable | None = None,
+        bucket_for: Callable | None = None,
     ):
         self.config = config or BatcherConfig()
         self.metrics = metrics or ServeMetrics()
         self._run_batch = run_batch
+        self._dispatch = dispatch
+        self._fetch = fetch
+        self._pipelined = dispatch is not None and fetch is not None
+        self._bucket_for = bucket_for if self.config.bucket_queues else None
         self._cv = threading.Condition()
-        self._queue: deque[_Pending] = deque()
+        self._queues: dict = {}      # bucket key -> deque[_Pending]
+        self._count = 0              # total pending across buckets
         self._closed = False
+        self._inflight_sem = threading.BoundedSemaphore(
+            self.config.max_in_flight
+        )
+        self._n_inflight = 0
+        self._completion: queue.Queue = queue.Queue()
+        self._fetch_thread = None
+        if self._pipelined:
+            self._fetch_thread = threading.Thread(
+                target=self._completion_loop,
+                name="serve-batcher-fetch",
+                daemon=True,
+            )
+            self._fetch_thread.start()
         self._thread = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True
         )
@@ -94,31 +139,64 @@ class DynamicBatcher:
         the retry-after hint is one max-delay window, the time one flush
         takes to drain ``max_batch`` slots.
         """
+        key = self._bucket_for(payload) if self._bucket_for else None
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            if len(self._queue) >= self.config.max_queue:
+            if self._count >= self.config.max_queue:
                 self.metrics.rejected.inc()
                 # One flush window, floored at 1 ms so a zero-delay config
                 # still hands clients a usable (non-zero) retry hint.
                 raise Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
             pending = _Pending(payload)
-            self._queue.append(pending)
+            self._queues.setdefault(key, deque()).append(pending)
+            self._count += 1
             self.metrics.requests.inc()
-            self.metrics.queue_depth.set(len(self._queue))
+            self.metrics.queue_depth.set(self._count)
             self._cv.notify_all()
         return pending.future
 
+    # ------------------------------------------------------------- flusher
+
+    def _full_bucket(self):
+        """(found, key) for a bucket at max_batch, oldest head first
+        (fairness). A plain key can't signal absence: the single-queue
+        mode's bucket key IS None."""
+        found, best = False, None
+        for key, q in self._queues.items():
+            if len(q) >= self.config.max_batch and (
+                not found
+                or q[0].t_enqueue < self._queues[best][0].t_enqueue
+            ):
+                found, best = True, key
+        return found, best
+
+    def _oldest_bucket(self):
+        return min(
+            self._queues, key=lambda k: self._queues[k][0].t_enqueue
+        )
+
     def _take_batch(self) -> list[_Pending] | None:
-        """Block until a batch is due (size or deadline) or close drains."""
+        """Block until a batch is due (size or deadline) or close drains.
+
+        The deadline is GLOBAL: the wait tracks the oldest request across
+        all buckets, so a lone request in a cold bucket still flushes
+        within ``max_delay_ms`` of arrival.
+        """
         max_delay = self.config.max_delay_ms / 1e3
         with self._cv:
             while True:
-                if self._queue:
-                    if len(self._queue) >= self.config.max_batch or self._closed:
+                if self._count:
+                    full, key = self._full_bucket()
+                    if full or self._closed:
+                        if not full:
+                            key = self._oldest_bucket()
                         break
+                    key = self._oldest_bucket()
                     remaining = (
-                        self._queue[0].t_enqueue + max_delay - time.monotonic()
+                        self._queues[key][0].t_enqueue
+                        + max_delay
+                        - time.monotonic()
                     )
                     if remaining <= 0:
                         break
@@ -127,47 +205,127 @@ class DynamicBatcher:
                     return None
                 else:
                     self._cv.wait()
+            q = self._queues[key]
             batch = [
-                self._queue.popleft()
-                for _ in range(min(len(self._queue), self.config.max_batch))
+                q.popleft()
+                for _ in range(min(len(q), self.config.max_batch))
             ]
-            self.metrics.queue_depth.set(len(self._queue))
+            if not q:
+                del self._queues[key]
+            self._count -= len(batch)
+            self.metrics.queue_depth.set(self._count)
             return batch
+
+    def _fail(self, batch: list[_Pending], exc: BaseException) -> None:
+        self.metrics.errors.inc()
+        for p in batch:
+            if not p.future.cancelled():
+                p.future.set_exception(exc)
+
+    def _deliver(self, batch: list[_Pending], results) -> None:
+        if len(results) != len(batch):
+            # An engine that answers short would leave the excess futures
+            # pending FOREVER under a bare zip — fail the whole batch
+            # loudly instead (the satellite fix for the silent drop).
+            self._fail(
+                batch,
+                RuntimeError(
+                    f"engine returned {len(results)} results for a batch "
+                    f"of {len(batch)} requests"
+                ),
+            )
+            return
+        now = time.monotonic()
+        for p, r in zip(batch, results):
+            self.metrics.latency.observe(now - p.t_enqueue)
+            if not p.future.cancelled():
+                p.future.set_result(r)
 
     def _loop(self):
         while True:
             batch = self._take_batch()
             if batch is None:
+                if self._pipelined:
+                    self._completion.put(None)  # unblock the fetch thread
                 return
             self.metrics.batches.inc()
             self.metrics.batch_occupancy.observe(len(batch))
-            try:
-                results = self._run_batch([p.payload for p in batch])
-            except Exception as e:  # noqa: BLE001 — fail the batch, not the server
-                self.metrics.errors.inc()
-                for p in batch:
-                    if not p.future.cancelled():
-                        p.future.set_exception(e)
+            if not self._pipelined:
+                try:
+                    results = self._run_batch([p.payload for p in batch])
+                except Exception as e:  # noqa: BLE001 — fail the batch, not the server
+                    self._fail(batch, e)
+                    continue
+                self._deliver(batch, results)
                 continue
-            now = time.monotonic()
-            for p, r in zip(batch, results):
-                self.metrics.latency.observe(now - p.t_enqueue)
-                if not p.future.cancelled():
-                    p.future.set_result(r)
+            # Overlapped path: launch, hand off to the completion thread,
+            # and immediately assemble the next batch. The semaphore
+            # bounds dispatched-but-unfetched batches to max_in_flight.
+            self._inflight_sem.acquire()
+            try:
+                handle = self._dispatch([p.payload for p in batch])
+            except Exception as e:  # noqa: BLE001
+                self._inflight_sem.release()
+                self._fail(batch, e)
+                continue
+            with self._cv:
+                self._n_inflight += 1
+                self.metrics.in_flight.set(self._n_inflight)
+            self._completion.put((batch, handle))
 
-    def close(self, drain: bool = True) -> None:
+    def _completion_loop(self):
+        while True:
+            item = self._completion.get()
+            if item is None:
+                return
+            batch, handle = item
+            try:
+                results = self._fetch(handle)
+            except Exception as e:  # noqa: BLE001
+                self._fail(batch, e)
+            else:
+                self._deliver(batch, results)
+            finally:
+                with self._cv:
+                    self._n_inflight -= 1
+                    self.metrics.in_flight.set(self._n_inflight)
+                self._inflight_sem.release()
+
+    def close(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
         """Stop the flusher. ``drain=True`` serves what's queued first;
-        otherwise pending futures fail with a RuntimeError."""
+        otherwise pending futures fail with a RuntimeError.
+
+        Raises ``RuntimeError`` if the worker threads are still alive after
+        ``join_timeout_s`` — a wedged engine must be VISIBLE, not a
+        silently leaked daemon thread.
+        """
         with self._cv:
             if self._closed:
                 return
             self._closed = True
             if not drain:
-                while self._queue:
-                    p = self._queue.popleft()
-                    p.future.set_exception(RuntimeError("batcher closed"))
+                while self._queues:
+                    _, q = self._queues.popitem()
+                    while q:
+                        p = q.popleft()
+                        p.future.set_exception(RuntimeError("batcher closed"))
+                self._count = 0
             self._cv.notify_all()
-        self._thread.join(timeout=30)
+        self._thread.join(timeout=join_timeout_s)
+        if self._fetch_thread is not None:
+            self._fetch_thread.join(timeout=join_timeout_s)
+        stuck = [
+            t.name
+            for t in (self._thread, self._fetch_thread)
+            if t is not None and t.is_alive()
+        ]
+        if stuck:
+            msg = (
+                f"batcher thread(s) {stuck} still running after "
+                f"{join_timeout_s:.0f}s close timeout — engine likely wedged"
+            )
+            logger.error(msg)
+            raise RuntimeError(msg)
 
     def __enter__(self):
         return self

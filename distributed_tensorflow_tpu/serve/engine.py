@@ -2,12 +2,23 @@
 
 Design (the serving half of the training engine's "one trace, one
 executable" rule): every forward an engine will ever run is lowered and
-compiled at STARTUP — one executable per sequence bucket for BERT, one per
-image geometry for the classifiers — so no user request ever pays a trace
-or an XLA compile. Requests of arbitrary length pad up to the smallest
-bucket that fits (``BertInferenceEngine.buckets``, default {128, 256, 512}
-clamped to the model's ``max_position``); partial batches pad with inert
-rows to the fixed ``max_batch`` so the executable's shapes never vary.
+compiled at STARTUP — one executable per (batch tier x sequence bucket)
+for BERT, one per (batch tier x image geometry) for the classifiers — so
+no user request ever pays a trace or an XLA compile. Requests of
+arbitrary length pad up to the smallest bucket that fits
+(``BertInferenceEngine.buckets``, default {128, 256, 512} clamped to the
+model's ``max_position``); partial batches pad with inert rows to the
+SMALLEST batch tier that holds them (``batch_tiers``, default {1, 2, 4, 8}
+clamped to ``max_batch``), so a lone request runs a 1-row executable
+instead of paying a full ``max_batch``-row forward.
+
+The request path is split ``assemble -> dispatch -> fetch``: ``dispatch``
+stages host buffers (drawn from a reusable pool) into the right
+executable and returns an :class:`InFlightBatch` of device refs WITHOUT
+blocking; ``fetch`` is the only point that calls ``jax.device_get``. The
+batcher exploits the split to pipeline batch k+1's host assembly against
+batch k's device compute (``max_in_flight``). ``run_batch`` remains the
+blocking composition of the two for direct callers.
 
 Placement mirrors training: params live replicated on the serving mesh
 (the DP-only analog of ``place_state``), batches shard their leading dim
@@ -22,8 +33,10 @@ sharded arrays onto the serving mesh on read.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import math
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -58,29 +71,104 @@ def _batch_sharding_or_replicated(mesh, max_batch: int):
     return replicated_sharding(mesh)
 
 
-class _AotEngine:
-    """Shared AOT plumbing: compile-per-shape at startup, place-and-call."""
+def _normalize_tiers(tiers, max_batch: int) -> tuple[int, ...]:
+    """Clamp the tier ladder to ``max_batch`` and guarantee a full-batch
+    rung — the grid must always hold a ``max_batch``-row flush."""
+    tiers = tuple(tiers) if tiers else (1, 2, 4, 8)
+    t = {min(int(x), max_batch) for x in tiers if int(x) >= 1}
+    t.add(max_batch)
+    return tuple(sorted(t))
 
-    def __init__(self, mesh, max_batch: int):
+
+@dataclasses.dataclass
+class InFlightBatch:
+    """A dispatched-but-unfetched batch: device refs + host bookkeeping.
+
+    ``out`` holds un-materialized device arrays (dispatch is async); the
+    staging buffers ride along so ``fetch`` can return them to the pool
+    once the transfer out is complete.
+    """
+
+    out: dict
+    key: tuple          # (tier, bucket) executable key
+    n: int              # real rows (the rest of the tier is padding)
+    meta: list          # per-row bookkeeping (e.g. unpadded lengths)
+    buffers: tuple      # host staging arrays to recycle on fetch
+
+
+class _AotEngine:
+    """Shared AOT plumbing: compile-per-shape at startup, place-and-call.
+
+    Subclasses provide ``dispatch``/``fetch``; this base owns the tier
+    ladder, per-tier batch shardings, the staging-buffer pool, and the
+    per-dispatch metrics recording (``self.metrics`` is wired by
+    :class:`serve.server.Client`; it stays ``None`` for bare engines).
+    """
+
+    def __init__(self, mesh, max_batch: int, batch_tiers=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.mesh = mesh if mesh is not None else build_mesh({"data": -1})
         self.max_batch = max_batch
+        self.batch_tiers = _normalize_tiers(batch_tiers, max_batch)
+        self.metrics = None
         self._param_sharding = replicated_sharding(self.mesh)
-        self._batch_sharding = _batch_sharding_or_replicated(
-            self.mesh, max_batch
+        self._tier_sharding = {
+            t: _batch_sharding_or_replicated(self.mesh, t)
+            for t in self.batch_tiers
+        }
+        self._buf_lock = threading.Lock()
+        self._buf_pool: dict[tuple, list[tuple]] = {}
+
+    def tier_for(self, n: int) -> int:
+        """Smallest compiled batch tier holding ``n`` rows."""
+        for t in self.batch_tiers:
+            if n <= t:
+                return t
+        raise ValueError(
+            f"batch of {n} exceeds max_batch {self.max_batch}"
         )
 
     def _place(self, tree):
         return jax.device_put(tree, self._param_sharding)
 
-    def _struct(self, shape, dtype):
+    def _struct(self, shape, dtype, tier: int):
         return jax.ShapeDtypeStruct(
-            shape, dtype, sharding=self._batch_sharding
+            shape, dtype, sharding=self._tier_sharding[tier]
         )
 
-    def _put(self, x):
-        return jax.device_put(x, self._batch_sharding)
+    def _put(self, x, tier: int):
+        return jax.device_put(x, self._tier_sharding[tier])
+
+    def _take_buffers(self, key: tuple, make) -> tuple:
+        """Pop a staging-buffer set for ``key`` or allocate a fresh one.
+        Buffers return to the pool in ``fetch`` (after ``device_get``, when
+        reuse provably cannot race the transfer in)."""
+        with self._buf_lock:
+            pool = self._buf_pool.get(key)
+            if pool:
+                return pool.pop()
+        return make()
+
+    def _give_buffers(self, key: tuple, buffers: tuple) -> None:
+        with self._buf_lock:
+            self._buf_pool.setdefault(key, []).append(buffers)
+
+    def _record_dispatch(self, tier: int, bucket, n: int) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        m.tier_hits.inc(tier)
+        if bucket is not None:
+            m.bucket_hits.inc(bucket)
+        m.tier_occupancy.observe(tier, n)
+        m.padded_rows.inc(tier - n)
+
+    # -- blocking compatibility surface --------------------------------
+
+    def run_batch(self, payloads: list[dict]) -> list[dict]:
+        """Blocking execute: ``fetch(dispatch(payloads))``."""
+        return self.fetch(self.dispatch(payloads))
 
 
 class BertInferenceEngine(_AotEngine):
@@ -111,9 +199,10 @@ class BertInferenceEngine(_AotEngine):
         *,
         buckets: tuple[int, ...] = (128, 256, 512),
         max_batch: int = 8,
+        batch_tiers: tuple[int, ...] | None = None,
         return_logits: bool = False,
     ):
-        super().__init__(mesh, max_batch)
+        super().__init__(mesh, max_batch, batch_tiers)
         self.model = model
         cfg = model.cfg
         self.buckets = tuple(
@@ -123,26 +212,29 @@ class BertInferenceEngine(_AotEngine):
             raise ValueError("need at least one sequence bucket")
         self.return_logits = return_logits
         self.params = self._place(params)
-        # AOT-compile one executable per bucket NOW: startup pays every
-        # trace/compile, the request path pays none (jit cache lookups
-        # included — these are Compiled objects, not jit wrappers).
+        # AOT-compile one executable per (batch tier, sequence bucket) NOW:
+        # startup pays every trace/compile, the request path pays none (jit
+        # cache lookups included — these are Compiled objects, not jit
+        # wrappers). A partial flush dispatches at the smallest tier that
+        # fits instead of padding to max_batch.
         self._compiled = {}
-        for L in self.buckets:
-            b = (self.max_batch, L)
-            self._compiled[L] = (
-                jax.jit(self._forward)
-                .lower(
-                    self.params,
-                    self._struct(b, jnp.int32),
-                    self._struct(b, jnp.bool_),
-                    self._struct(b, jnp.int32),
-                    self._struct(b, jnp.int32),
+        for T in self.batch_tiers:
+            for L in self.buckets:
+                b = (T, L)
+                self._compiled[T, L] = (
+                    jax.jit(self._forward)
+                    .lower(
+                        self.params,
+                        self._struct(b, jnp.int32, T),
+                        self._struct(b, jnp.bool_, T),
+                        self._struct(b, jnp.int32, T),
+                        self._struct(b, jnp.int32, T),
+                    )
+                    .compile()
                 )
-                .compile()
-            )
         logger.info(
-            "BERT engine ready: buckets=%s max_batch=%d (%d executables)",
-            self.buckets, self.max_batch, len(self._compiled),
+            "BERT engine ready: buckets=%s tiers=%s (%d executables)",
+            self.buckets, self.batch_tiers, len(self._compiled),
         )
 
     def _forward(self, params, input_ids, attention_mask, token_type_ids,
@@ -198,15 +290,23 @@ class BertInferenceEngine(_AotEngine):
             if k in payload and np.asarray(payload[k]).shape != ids.shape:
                 raise RequestError(f"{k} shape must match input_ids")
 
-    def run_batch(self, payloads: list[dict]) -> list[dict]:
-        """Execute one micro-batch (the batcher's flush callback).
+    def request_bucket(self, payload: dict) -> int:
+        """Queue key for bucket-aware batching: the sequence bucket this
+        payload would pad to (batcher groups same-bucket requests)."""
+        return self.bucket_for(np.asarray(payload["input_ids"]).shape[0])
+
+    def dispatch(self, payloads: list[dict]) -> InFlightBatch:
+        """Assemble one micro-batch and launch it; returns WITHOUT blocking
+        on device compute (the returned refs materialize in ``fetch``).
 
         Pads every row to the batch's bucket — the smallest bucket holding
         the LONGEST member (mixed-length batches pay the longest member's
-        bucket) — and pads missing rows to ``max_batch`` with inert rows
-        (mask True only at position 0: fully-masked rows would softmax
-        over zero keys; the padded rows' outputs are sliced off anyway,
-        but NaNs should never exist in a served buffer).
+        bucket; per-bucket queues in the batcher avoid assembling such
+        batches in the first place) — and pads missing rows to the
+        smallest batch TIER that fits with inert rows (mask True only at
+        position 0: fully-masked rows would softmax over zero keys; the
+        padded rows' outputs are sliced off anyway, but NaNs should never
+        exist in a served buffer).
         """
         if len(payloads) > self.max_batch:
             raise ValueError(
@@ -214,11 +314,22 @@ class BertInferenceEngine(_AotEngine):
             )
         lens = [np.asarray(p["input_ids"]).shape[0] for p in payloads]
         L = self.bucket_for(max(lens))
-        B = self.max_batch
-        ids = np.zeros((B, L), np.int32)
-        mask = np.zeros((B, L), bool)
-        types = np.zeros((B, L), np.int32)
-        targets = np.full((B, L), -1, np.int32)
+        T = self.tier_for(len(payloads))
+        key = (T, L)
+
+        def _make():
+            return (
+                np.zeros((T, L), np.int32),
+                np.zeros((T, L), bool),
+                np.zeros((T, L), np.int32),
+                np.full((T, L), -1, np.int32),
+            )
+
+        ids, mask, types, targets = buffers = self._take_buffers(key, _make)
+        ids.fill(0)
+        mask.fill(False)
+        types.fill(0)
+        targets.fill(-1)
         for r, (p, l) in enumerate(zip(payloads, lens)):
             ids[r, :l] = np.asarray(p["input_ids"], np.int32)
             mask[r, :l] = True
@@ -227,16 +338,25 @@ class BertInferenceEngine(_AotEngine):
             if "mlm_targets" in p:
                 targets[r, :l] = np.asarray(p["mlm_targets"], np.int32)
         mask[len(payloads):, 0] = True
-        out = self._compiled[L](
+        out = self._compiled[key](
             self.params,
-            self._put(ids),
-            self._put(mask),
-            self._put(types),
-            self._put(targets),
+            self._put(ids, T),
+            self._put(mask, T),
+            self._put(types, T),
+            self._put(targets, T),
         )
-        out = jax.device_get(out)
+        self._record_dispatch(T, L, len(payloads))
+        return InFlightBatch(
+            out=out, key=key, n=len(payloads), meta=lens, buffers=buffers
+        )
+
+    def fetch(self, inflight: InFlightBatch) -> list[dict]:
+        """Block on the in-flight batch and slice out per-row results."""
+        out = jax.device_get(inflight.out)
+        self._give_buffers(inflight.key, inflight.buffers)
+        L = inflight.key[1]
         results = []
-        for r, l in enumerate(lens):
+        for r, l in enumerate(inflight.meta):
             count = float(out["count"][r])
             res = {
                 "pred_ids": out["pred_ids"][r, :l],
@@ -270,26 +390,30 @@ class ImageClassifierEngine(_AotEngine):
         *,
         image_shape: tuple[int, int, int],
         max_batch: int = 8,
+        batch_tiers: tuple[int, ...] | None = None,
         top_k: int = 5,
     ):
-        super().__init__(mesh, max_batch)
+        super().__init__(mesh, max_batch, batch_tiers)
         self.model = model
         self.image_shape = tuple(image_shape)
         self.top_k = top_k
         self.variables = self._place(
             {"params": params, **(model_state or {})}
         )
-        self._compiled_fn = (
-            jax.jit(self._forward)
-            .lower(
-                self.variables,
-                self._struct((self.max_batch, *self.image_shape), jnp.float32),
+        self._compiled = {
+            T: (
+                jax.jit(self._forward)
+                .lower(
+                    self.variables,
+                    self._struct((T, *self.image_shape), jnp.float32, T),
+                )
+                .compile()
             )
-            .compile()
-        )
+            for T in self.batch_tiers
+        }
         logger.info(
-            "image engine ready: shape=%s max_batch=%d top_k=%d",
-            self.image_shape, self.max_batch, top_k,
+            "image engine ready: shape=%s tiers=%s top_k=%d",
+            self.image_shape, self.batch_tiers, top_k,
         )
 
     def _forward(self, variables, image):
@@ -306,16 +430,33 @@ class ImageClassifierEngine(_AotEngine):
                 f"image shape {img.shape} != engine geometry {self.image_shape}"
             )
 
-    def run_batch(self, payloads: list[dict]) -> list[dict]:
+    def request_bucket(self, payload: dict) -> int:
+        return 0  # one geometry: every request shares the single bucket
+
+    def dispatch(self, payloads: list[dict]) -> InFlightBatch:
         if len(payloads) > self.max_batch:
             raise ValueError(
                 f"batch of {len(payloads)} exceeds max_batch {self.max_batch}"
             )
-        imgs = np.zeros((self.max_batch, *self.image_shape), np.float32)
+        T = self.tier_for(len(payloads))
+
+        def _make():
+            return (np.zeros((T, *self.image_shape), np.float32),)
+
+        (imgs,) = buffers = self._take_buffers((T,), _make)
+        imgs.fill(0.0)
         for r, p in enumerate(payloads):
             imgs[r] = np.asarray(p["image"], np.float32)
-        out = jax.device_get(self._compiled_fn(self.variables, self._put(imgs)))
+        out = self._compiled[T](self.variables, self._put(imgs, T))
+        self._record_dispatch(T, None, len(payloads))
+        return InFlightBatch(
+            out=out, key=(T,), n=len(payloads), meta=[], buffers=buffers
+        )
+
+    def fetch(self, inflight: InFlightBatch) -> list[dict]:
+        out = jax.device_get(inflight.out)
+        self._give_buffers(inflight.key, inflight.buffers)
         return [
             {"top_ids": out["top_ids"][r], "top_probs": out["top_probs"][r]}
-            for r in range(len(payloads))
+            for r in range(inflight.n)
         ]

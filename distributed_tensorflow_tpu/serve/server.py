@@ -57,8 +57,28 @@ class Client:
                 f"batcher max_batch {config.max_batch} exceeds engine "
                 f"max_batch {engine.max_batch}"
             )
+        # Engines that expose the split hot path (dispatch/fetch) get the
+        # overlapped batcher; engines that expose a bucket key get
+        # bucket-aware queues when the config asks for them. Stub engines
+        # with only run_batch keep the classic serial path.
+        if getattr(engine, "metrics", False) is None:
+            engine.metrics = self.metrics  # per-tier/bucket instruments
+        bucket_for = (
+            getattr(engine, "request_bucket", None)
+            if config.bucket_queues
+            else None
+        )
+        if config.bucket_queues and bucket_for is None:
+            raise ValueError(
+                "bucket_queues=True needs an engine with request_bucket()"
+            )
         self.batcher = DynamicBatcher(
-            engine.run_batch, config, metrics=self.metrics
+            engine.run_batch,
+            config,
+            metrics=self.metrics,
+            dispatch=getattr(engine, "dispatch", None),
+            fetch=getattr(engine, "fetch", None),
+            bucket_for=bucket_for,
         )
 
     def submit(self, payload: dict) -> Future:

@@ -60,8 +60,11 @@ def fit(
         batch = next(it)
         state, metrics = train_step(state, batch, rng)
         if log_every and ((step + 1) % log_every == 0 or step + 1 == num_steps):
-            # Fetch (blocks on the step stream only here).
-            fetched = {k: float(v) for k, v in metrics.items()}
+            # Fetch (blocks on the step stream only here) — ONE device_get
+            # for the whole dict, not a per-leaf float() sync each.
+            fetched = {
+                k: float(v) for k, v in jax.device_get(metrics).items()
+            }
             dt = time.perf_counter() - t0
             steps_done = step + 1 - start_step
             fetched["steps_per_sec"] = steps_done / dt if dt > 0 else 0.0
@@ -77,7 +80,10 @@ def fit(
         if evaluate is not None and eval_every and (
             (step + 1) % eval_every == 0 or step + 1 == num_steps
         ):
-            ev = {f"eval_{k}": float(v) for k, v in evaluate(state).items()}
+            ev = {
+                f"eval_{k}": float(v)
+                for k, v in jax.device_get(evaluate(state)).items()
+            }
             if jax.process_index() == 0:
                 logger.info(
                     "step %d eval: %s",

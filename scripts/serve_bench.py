@@ -9,12 +9,18 @@ Open-loop matters: requests arrive on a fixed schedule regardless of how
 fast replies come back, so queueing delay shows up in the tail instead of
 being hidden by a closed feedback loop. At each offered load the report
 gives achieved throughput, p50/p99 latency, mean batch occupancy (how well
-the batcher is packing the fixed-size executable), and the rejection count
-(backpressure engaging past saturation).
+the batcher is packing the executable grid), padded-rows-wasted (executable
+rows burned on inert padding), the per-tier dispatch distribution, and the
+rejection count (backpressure engaging past saturation). Before the sweep a
+CLOSED-loop single-stream pass measures occupancy-1 throughput — the number
+the tiered-AOT grid exists to improve (a lone request runs a 1-row
+executable instead of a max-batch-row one).
 
     JAX_PLATFORMS=cpu python scripts/serve_bench.py
     python scripts/serve_bench.py --loads 100 400 1600 --duration 3
-    python scripts/serve_bench.py --json results.json
+    python scripts/serve_bench.py --batch-tiers 8        # fixed-batch baseline
+    python scripts/serve_bench.py --bucket-queues --json results.json
+    python scripts/serve_bench.py --quick                # CI smoke (~seconds)
 """
 
 from __future__ import annotations
@@ -75,7 +81,11 @@ def build_client(args):
         print(f"# serving checkpoint step {step} from {args.ckpt_dir}")
 
     engine = BertInferenceEngine(
-        model, params, buckets=tuple(args.buckets), max_batch=args.max_batch
+        model,
+        params,
+        buckets=tuple(args.buckets),
+        max_batch=args.max_batch,
+        batch_tiers=tuple(args.batch_tiers),
     )
     client = Client(
         engine,
@@ -83,6 +93,8 @@ def build_client(args):
             max_batch=args.max_batch,
             max_delay_ms=args.max_delay_ms,
             max_queue=args.max_queue,
+            max_in_flight=args.max_in_flight,
+            bucket_queues=args.bucket_queues,
         ),
     )
     return client, cfg.vocab_size
@@ -97,6 +109,22 @@ def make_payloads(vocab: int, buckets, n: int = 256) -> list[dict]:
         ids = rng.integers(5, vocab, size=l)
         out.append({"input_ids": ids, "mlm_targets": ids})
     return out
+
+
+def run_single_stream(client, payloads, duration_s: float) -> dict:
+    """Closed-loop occupancy-1 throughput: submit one, wait, repeat.
+
+    Every request flushes alone (deadline trigger), so this measures the
+    cost of serving a lone request — the padding-waste worst case the
+    batch-tier grid targets.
+    """
+    t0 = time.monotonic()
+    served = 0
+    while time.monotonic() - t0 < duration_s:
+        client.call(payloads[served % len(payloads)], timeout=120)
+        served += 1
+    wall = time.monotonic() - t0
+    return {"served": served, "wall_s": wall, "rps": served / wall}
 
 
 def run_load(client, payloads, offered_rps: float, duration_s: float) -> dict:
@@ -141,54 +169,103 @@ def main(argv=None) -> int:
                    help="seconds per offered-load point")
     p.add_argument("--buckets", type=int, nargs="+", default=[32, 64, 128])
     p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--batch-tiers", type=int, nargs="+", default=[1, 2, 4, 8],
+                   help="batch tiers to AOT-compile; pass a single "
+                   "max-batch value for the fixed-batch baseline")
+    p.add_argument("--max-in-flight", type=int, default=2,
+                   help="overlapped dispatch depth (1 = serial host/device)")
+    p.add_argument("--bucket-queues", action="store_true",
+                   help="per-sequence-bucket request queues")
     p.add_argument("--max-delay-ms", type=float, default=8.0)
     p.add_argument("--max-queue", type=int, default=256)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--hidden", type=int, default=64)
     p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--single-duration", type=float, default=1.0,
+                   help="seconds for the closed-loop occupancy-1 pass "
+                   "(0 disables it)")
+    p.add_argument("--quick", action="store_true",
+                   help="CI smoke: tiny model, one short load point")
     p.add_argument("--ckpt-dir", default="",
                    help="serve a real checkpoint instead of random init")
     p.add_argument("--json", default="", help="also write results here")
     args = p.parse_args(argv)
 
+    if args.quick:
+        args.loads = [50.0]
+        args.duration = 0.5
+        args.single_duration = min(args.single_duration, 0.5)
+        args.buckets = [16, 32]
+        args.layers, args.hidden, args.vocab = 1, 32, 128
+
     client, vocab = build_client(args)
     payloads = make_payloads(vocab, args.buckets)
+    metrics = client.metrics
 
-    # Warmup: fill every bucket's executable path + the thread machinery.
+    # Warmup: fill every executable path + the thread machinery.
     for f in [client.submit(payloads[i]) for i in range(16)]:
         f.result(timeout=120)
 
+    report = {"config": {
+        "batch_tiers": list(client.engine.batch_tiers),
+        "max_batch": args.max_batch,
+        "max_in_flight": args.max_in_flight,
+        "bucket_queues": args.bucket_queues,
+        "max_delay_ms": args.max_delay_ms,
+    }}
     rows = []
     try:
+        if args.single_duration > 0:
+            single = run_single_stream(
+                client, payloads, args.single_duration
+            )
+            report["single_stream"] = single
+            print(
+                f"# single-stream (occupancy-1): {single['rps']:.1f} req/s "
+                f"over {single['served']} requests"
+            )
         for rps in args.loads:
-            # Per-point metrics: fresh histograms so p99 is per-load.
-            client.metrics.latency.reset()
-            client.metrics.batch_occupancy.reset()
+            # Per-point metrics: fresh histograms so p99 is per-load;
+            # counters diff across the point (they are cumulative).
+            metrics.latency.reset()
+            metrics.batch_occupancy.reset()
+            metrics.tier_hits.reset()
+            metrics.bucket_hits.reset()
+            padded0 = metrics.padded_rows.value
+            batches0 = metrics.batches.value
             r = run_load(client, payloads, rps, args.duration)
-            snap = client.metrics.snapshot()
+            snap = metrics.snapshot()
             r["p50_ms"] = snap["latency_ms"]["p50"]
             r["p99_ms"] = snap["latency_ms"]["p99"]
             r["mean_batch_occupancy"] = snap["batch_occupancy"]["mean"]
+            r["batches"] = snap["batches"] - batches0
+            r["padded_rows"] = snap["padded_rows"] - padded0
+            r["tier_hits"] = snap["tier_hits"]
+            r["bucket_hits"] = snap["bucket_hits"]
             rows.append(r)
     finally:
         client.close()
+    report["loads"] = rows
 
     hdr = (
         f"{'offered rps':>12} {'achieved rps':>13} {'served':>7} "
-        f"{'rejected':>9} {'p50 ms':>8} {'p99 ms':>8} {'occupancy':>10}"
+        f"{'rejected':>9} {'p50 ms':>8} {'p99 ms':>8} {'occupancy':>10} "
+        f"{'padded rows':>12}  tier hits"
     )
     print(hdr)
     print("-" * len(hdr))
     for r in rows:
+        tiers = ",".join(f"{k}:{v}" for k, v in r["tier_hits"].items())
         print(
             f"{r['offered_rps']:>12.1f} {r['achieved_rps']:>13.1f} "
             f"{r['served']:>7d} {r['rejected']:>9d} "
             f"{r['p50_ms']:>8.2f} {r['p99_ms']:>8.2f} "
-            f"{r['mean_batch_occupancy']:>10.2f}"
+            f"{r['mean_batch_occupancy']:>10.2f} "
+            f"{r['padded_rows']:>12d}  {tiers}"
         )
     if args.json:
         with open(args.json, "w") as fh:
-            json.dump(rows, fh, indent=2)
+            json.dump(report, fh, indent=2)
         print(f"# wrote {args.json}")
     return 0
 
