@@ -94,8 +94,25 @@ def main():
     ds = synthetic_image_classification(
         global_batch, (image_hw, image_hw, 3), 1000, seed=0
     )
-    batch = coll.shard_batch({"image": ds.images, "label": ds.labels}, mesh)
     rng = jax.random.key(0)
+
+    # BENCH_FEED=stream: feed every step a fresh host-assembled batch
+    # through the async prefetch stage (data/prefetch.py) instead of one
+    # resident device batch — measures end-to-end throughput WITH the feed
+    # in the loop (vs the default device-only number). BENCH_PREFETCH sets
+    # the lookahead depth (0 = synchronous feed, the r5-era behavior).
+    feed_mode = os.environ.get("BENCH_FEED", "")
+    if feed_mode == "stream":
+        from distributed_tensorflow_tpu.data import device_batches
+        from distributed_tensorflow_tpu.data.prefetch import prefetch
+
+        depth = int(os.environ.get("BENCH_PREFETCH", "2"))
+        stream = prefetch(device_batches(ds, mesh, global_batch, seed=0), depth)
+    elif feed_mode:
+        raise SystemExit(f"BENCH_FEED must be '' or 'stream', got {feed_mode!r}")
+    else:
+        stream = None
+        batch = coll.shard_batch({"image": ds.images, "label": ds.labels}, mesh)
 
     # Warmup: compile + 2 steady steps. Synchronization note: on the tunneled
     # TPU platform here, block_until_ready returns before the computation
@@ -105,7 +122,7 @@ def main():
         nonlocal state
         t0 = time.perf_counter()
         for _ in range(n_steps):
-            state, metrics = step(state, batch, rng)
+            state, metrics = step(state, batch if stream is None else next(stream), rng)
         float(metrics["loss"])
         return time.perf_counter() - t0
 
@@ -122,6 +139,8 @@ def main():
     shorts = sorted(window(n_short) for _ in range(reps))
     per_step = (longs[reps // 2] - shorts[reps // 2]) / (n_long - n_short)
     spread = (longs[-1] - longs[0]) / longs[reps // 2]
+    if stream is not None:
+        stream.close()
 
     images_per_sec_chip = global_batch / per_step / n
     # MFU accounting is defined for the 224x224 workload; scale FLOPs if the
@@ -154,7 +173,13 @@ def main():
                 "unit": f"images/sec/chip (bf16, b={per_chip_batch}/chip, "
                 f"{image_hw}x{image_hw}, {n}x {devices[0].device_kind}, "
                 f"mfu={mfu:.3f}, median of {reps}x{n_long}-step windows, "
-                f"spread={spread:.1%}, {peak_note}, {ceil_note})",
+                f"spread={spread:.1%}, "
+                + (
+                    f"feed=stream+prefetch{stream.depth}, "
+                    if stream is not None
+                    else "feed=resident, "
+                )
+                + f"{peak_note}, {ceil_note})",
                 "vs_baseline": round(mfu / 0.55, 4),
             }
         )

@@ -88,6 +88,12 @@ class WorkloadConfig:
     # model revisits the pool every N steps, so it is NOT for convergence
     # claims beyond pool-sized epochs.
     device_pool: int = 0
+    # Feed-stage lookahead (data/prefetch.py): a feeder thread runs batch
+    # assembly + host->device transfer this many batches ahead of the step
+    # stream, so the loop's next(it) is a queue pop in steady state. 0 =
+    # synchronous feed (assembly on the critical path). Streams are
+    # bit-identical either way — the wrapper never skips or reorders.
+    prefetch: int = 2
     log_every: int = 50
     ckpt_every: int = 0
 
@@ -616,7 +622,20 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     )
     from distributed_tensorflow_tpu.train.step import place_state
 
-    initialize_runtime()
+    # Multi-host bootstrap: on TPU pods the coordinator/process topology
+    # comes from slice metadata (zero flags); the explicit flags are the
+    # documented entrypoint for CPU/GPU clusters and manual launchers.
+    initialize_runtime(
+        coordinator_address=getattr(args, "coordinator_address", "") or None,
+        num_processes=(
+            args.num_processes
+            if getattr(args, "num_processes", 0) > 0
+            else None
+        ),
+        process_id=(
+            args.process_id if getattr(args, "process_id", -1) >= 0 else None
+        ),
+    )
     mesh_spec = {"data": -1}
     if cfg.seq_parallel:
         mesh_spec["seq"] = cfg.seq_parallel
@@ -720,6 +739,17 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
 
         batches = itertools.cycle(pool)
 
+    from distributed_tensorflow_tpu.data.prefetch import prefetch
+    from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
+
+    feed_metrics = FeedMetrics()
+    if cfg.device_pool <= 0:
+        # Async feed stage: assembly + host->device transfer run on a
+        # feeder thread, cfg.prefetch batches ahead (0 = synchronous with
+        # the same metrics surface). Device-pool runs skip it — the pool is
+        # already resident in HBM, there is nothing to overlap.
+        batches = prefetch(batches, cfg.prefetch, metrics=feed_metrics)
+
     evaluate = None
     if args.eval_every and pieces.get("metric_fn") and pieces.get("eval_batches"):
         eval_step = make_eval_step(
@@ -767,6 +797,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
                 ckpt_every=cfg.ckpt_every or args.ckpt_every,
                 evaluate=evaluate,
                 eval_every=args.eval_every,
+                feed_metrics=feed_metrics,
             )
         if ckpt is not None and ckpt.latest_step() != int(state.step):
             ckpt.save(int(state.step), state, force=True)
@@ -845,6 +876,22 @@ def main(argv: list[str] | None = None):
                         help="pre-place N batches in HBM and cycle them "
                         "(device-rate runs on feed-bound hosts; revisits "
                         "the pool every N steps)")
+    parser.add_argument("--prefetch", type=int, default=-1,
+                        help="feed lookahead depth: a feeder thread runs "
+                        "batch assembly + host->device transfer N batches "
+                        "ahead of the step stream (default 2; 0 = "
+                        "synchronous feed). Batch streams are bit-identical "
+                        "for any N")
+    parser.add_argument("--coordinator-address", default="",
+                        help="multi-host bootstrap: coordinator ip:port for "
+                        "jax.distributed.initialize (TPU pods auto-detect; "
+                        "required for CPU/GPU clusters / manual launch)")
+    parser.add_argument("--num-processes", type=int, default=0,
+                        help="multi-host bootstrap: total process count "
+                        "(with --coordinator-address)")
+    parser.add_argument("--process-id", type=int, default=-1,
+                        help="multi-host bootstrap: this process's rank in "
+                        "[0, --num-processes)")
     parser.add_argument("--eval-every", type=int, default=0,
                         help="run held-out eval every N steps (0 = off)")
     parser.add_argument("--eval-batches", type=int, default=8,
@@ -927,6 +974,8 @@ def main(argv: list[str] | None = None):
         overrides["native_input"] = False
     if args.device_pool:
         overrides["device_pool"] = args.device_pool
+    if args.prefetch >= 0:
+        overrides["prefetch"] = args.prefetch
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     state, last = run(cfg, args)

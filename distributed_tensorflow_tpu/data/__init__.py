@@ -10,6 +10,11 @@ per-role code.
 Real-dataset readers are gated on local file presence (this environment has
 zero egress); the synthetic generators produce seeded, learnably-structured
 data so convergence tests are meaningful without downloads.
+
+Every producer composes with :func:`prefetch` (data/prefetch.py): a bounded
+feeder thread runs assembly + host→device transfer ahead of the step
+stream — the queue-runner overlap the reference had, without its
+nondeterminism (batch ``k`` stays a pure function of ``(seed, k)``).
 """
 
 from distributed_tensorflow_tpu.data.synthetic import (  # noqa: F401
@@ -19,6 +24,10 @@ from distributed_tensorflow_tpu.data.synthetic import (  # noqa: F401
 from distributed_tensorflow_tpu.data.loader import (  # noqa: F401
     device_batches,
     native_device_batches,
+)
+from distributed_tensorflow_tpu.data.prefetch import (  # noqa: F401
+    PrefetchIterator,
+    prefetch,
 )
 from distributed_tensorflow_tpu.data.text import (  # noqa: F401
     SyntheticMLM,

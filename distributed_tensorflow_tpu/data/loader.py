@@ -11,6 +11,11 @@ pure function of ``(seed, k)``, so a checkpoint-restored run passes
 ``start_step=N`` and consumes batches ``N, N+1, ...`` — never replaying
 ``0..N-1`` (the resume-correctness the reference's stateful queue runners
 could not give).
+
+Both run assembly + device placement inline in ``next()`` — wrap with
+``data.prefetch`` to move that work onto a feeder thread and off the step
+stream's critical path (the stream contract is unaffected: the wrapper
+consumes in order and never skips).
 """
 
 from __future__ import annotations
@@ -82,10 +87,14 @@ def device_batches(
         lo = slot * global_batch + proc * local_b
         idx = order[lo : lo + local_b]
         images = dataset.images[idx]
-        if images.dtype == np.uint8:
-            images = images.astype(np.float32) / 255.0
+        # Crop BEFORE the u8->f32 scale: per-pixel work then touches only
+        # surviving pixels (224² of a 256² store is 23% less convert
+        # traffic in the assembly hot path). Bit-identical output — crop
+        # commutes with the elementwise ops.
         if out_size is not None and images.shape[1:3] != tuple(out_size):
             images = _center_crop(images, out_size)
+        if images.dtype == np.uint8:
+            images = images.astype(np.float32) / 255.0
         if mean is not None:
             images = (images - mean) / stddev
         local = {

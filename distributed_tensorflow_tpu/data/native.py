@@ -99,6 +99,14 @@ class NativePipeline:
     ``stream_stride = num_hosts * batch`` with the SAME seed everywhere —
     all hosts then share each epoch's permutation and read disjoint slices
     (the explicit form of tf.data's ``shard(num_hosts, host_id)``).
+
+    The C++ pool overlaps *augmentation* with Python; ``next()`` still
+    copies the staged batch out and the caller still pays the
+    host→device transfer. Wrapping the consuming stream in
+    ``data.prefetch`` moves both off the step stream — the two queues
+    compose (C++ ring feeds the Python feeder thread). ``close()`` (or
+    exiting the ``with`` block) unblocks any thread waiting in ``next()``,
+    which then raises instead of returning garbage.
     """
 
     def __init__(
@@ -179,6 +187,12 @@ class NativePipeline:
     def __iter__(self):
         while True:
             yield self.next()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def close(self):
         if getattr(self, "_handle", None):

@@ -13,7 +13,9 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 PAD, CLS, SEP, MASK = 0, 1, 2, 3
 NUM_SPECIAL = 4
@@ -282,8 +284,6 @@ def bert_batch_specs(
     (``moe_dispatch="sharded"``), where the expert axis carries data like a
     DP axis and NOTHING in the model is redundantly replicated across it.
     """
-    from jax.sharding import PartitionSpec as P
-
     from distributed_tensorflow_tpu.parallel.mesh import data_axes
 
     dp = data_axes(mesh)
@@ -328,11 +328,12 @@ def mlm_device_batches(
     host generates ONLY its local slice (per-host generator streams seeded
     by ``(step, process_index)``) — no redundant global-batch work in the
     hot loop.
-    """
-    import numpy as np
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    Chain-sampling, masking, and placement all run inline in ``next()`` —
+    the generator is single-consumer by construction, so wrapping it in
+    ``data.prefetch`` moves the whole per-batch cost onto the feeder
+    thread without touching the ``(seed, k)`` stream contract.
+    """
     from distributed_tensorflow_tpu.parallel.mesh import data_axes, local_batch_size
 
     dp = data_axes(mesh)

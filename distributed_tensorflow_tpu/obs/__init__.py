@@ -10,6 +10,7 @@ TensorBoard's profile plugin (xprof).
 
 from distributed_tensorflow_tpu.obs.metrics import (  # noqa: F401
     Counter,
+    FeedMetrics,
     Gauge,
     Histogram,
     JsonlWriter,

@@ -194,6 +194,69 @@ class LabelledHistogram:
             self._hists.clear()
 
 
+class FeedMetrics:
+    """Feed-path observability bundle (data/prefetch.py wires the feeder
+    side; ``train.fit`` wires the consumer side and surfaces a summary at
+    its log cadence).
+
+    Two sides write into it:
+
+    - **feeder** (the prefetch thread, or the inline path when prefetch is
+      off): ``assembly`` histogram (seconds per batch of host assembly +
+      host→device transfer), ``batches_assembled`` counter, ``queue_depth``
+      gauge.
+    - **consumer** (the training loop / bench harness): ``observe_wait``
+      with the seconds it blocked waiting for a batch. In steady state with
+      prefetch on, host wait ≈ 0 — assembly is hidden behind device
+      compute; host wait ≈ assembly means the run is feed-bound.
+
+    ``window()`` pops the per-log-window summary (mean host wait since the
+    last call + current queue depth), so a feed-bound run is diagnosable
+    from the step log instead of inferred.
+    """
+
+    def __init__(self):
+        self.host_wait = Histogram()       # s/step the consumer blocked on feed
+        self.assembly = Histogram()        # s/batch of assembly + device put
+        self.queue_depth = Gauge()         # prefetch queue occupancy
+        self.batches_assembled = Counter()
+        self._lock = threading.Lock()
+        self._win_wait = 0.0
+        self._win_steps = 0
+
+    def observe_wait(self, seconds: float) -> None:
+        """Consumer-side: record one blocking wait for a batch."""
+        self.host_wait.observe(seconds)
+        with self._lock:
+            self._win_wait += float(seconds)
+            self._win_steps += 1
+
+    def window(self) -> dict:
+        """Pop the log-cadence summary (resets the window accumulators)."""
+        with self._lock:
+            wait, steps = self._win_wait, self._win_steps
+            self._win_wait, self._win_steps = 0.0, 0
+        return {
+            "host_wait_ms": (1e3 * wait / steps) if steps else 0.0,
+            "feed_queue_depth": self.queue_depth.value,
+        }
+
+    def snapshot(self) -> dict:
+        """Full-stream summary (feed_bench / tests)."""
+        return {
+            "host_wait_ms": {
+                k: (v * 1e3 if k != "count" else v)
+                for k, v in self.host_wait.summary().items()
+            },
+            "assembly_ms": {
+                k: (v * 1e3 if k != "count" else v)
+                for k, v in self.assembly.summary().items()
+            },
+            "queue_depth": self.queue_depth.value,
+            "batches_assembled": self.batches_assembled.value,
+        }
+
+
 class ServeMetrics:
     """The serving subsystem's observability bundle (serve/batcher.py wires
     it; serve/server.py exposes it as JSON at ``GET /metrics``)."""

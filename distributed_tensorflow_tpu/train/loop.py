@@ -6,9 +6,19 @@ Python around one compiled step; hooks become plain callables; there is no
 chief (every host runs the identical loop; host-dependent work like metric
 printing is gated on ``jax.process_index() == 0``).
 
-TPU-first detail: the loop never blocks on device values except at the
-logging cadence — metrics come back as device arrays and are only fetched
-every ``log_every`` steps, keeping the step stream fully async.
+TPU-first details:
+
+- the loop never blocks on device values except at the logging cadence —
+  metrics come back as device arrays and are only fetched every
+  ``log_every`` steps, keeping the step stream fully async;
+- the feed is **pull-ahead**: step ``i`` is dispatched *before* batch
+  ``i+1`` is fetched, so host batch assembly/transfer overlaps device
+  compute even for unwrapped producers, and composes with
+  ``data.prefetch`` (which moves the assembly itself onto a feeder
+  thread — in steady state ``next(it)`` is then a queue pop ≈ 0);
+- feed stalls are measured, not inferred: every blocking ``next(it)`` is
+  timed into ``feed_metrics`` and surfaced as ``host_wait_ms`` at the log
+  cadence alongside ``steps_per_sec``.
 """
 
 from __future__ import annotations
@@ -19,6 +29,8 @@ from collections.abc import Callable, Iterable, Iterator
 from typing import Any
 
 import jax
+
+from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +51,7 @@ def fit(
     ckpt_every: int = 0,
     evaluate: Callable[[Any], dict] | None = None,
     eval_every: int = 0,
+    feed_metrics: FeedMetrics | None = None,
 ):
     """Run the training loop; returns the final state.
 
@@ -49,25 +62,57 @@ def fit(
     ``evaluate(state) -> dict`` runs every ``eval_every`` steps (and at the
     end); its metrics reach the hooks prefixed ``eval_`` — the held-out
     accuracy loop the reference never had (SURVEY.md §4 "do better").
+
+    ``feed_metrics`` collects host-wait observations (every blocking
+    ``next(it)`` in the loop is timed into it); when ``data`` carries its
+    own bundle (a ``data.prefetch`` wrapper exposes ``.metrics``) that one
+    is picked up automatically so feeder- and consumer-side numbers land in
+    one place. Logged throughput is **steady-state**: the wall-clock origin
+    resets after the first step of the run completes, so step-0
+    tracing+compilation never dilutes ``steps_per_sec``.
     """
     if rng is None:
         rng = jax.random.key(0)
     it: Iterator = iter(data)
+    if feed_metrics is None:
+        feed_metrics = getattr(data, "metrics", None) or FeedMetrics()
     pending_metrics = None
-    t0 = time.perf_counter()
     start_step = int(state.step)
+    if start_step >= num_steps:
+        return state, None  # restored at (or past) the final step
+    t0 = time.perf_counter()  # run origin (only used if the run is 1 step)
+    t_steady = None           # reset after the first step: excludes compile
+    t_fetch = time.perf_counter()
+    batch = next(it)
+    feed_metrics.observe_wait(time.perf_counter() - t_fetch)
     for step in range(start_step, num_steps):
-        batch = next(it)
         state, metrics = train_step(state, batch, rng)
+        if t_steady is None:
+            # The first call paid tracing + compilation (dispatch itself is
+            # async); everything after this point is the steady-state
+            # stream the logged throughput should describe.
+            t_steady = time.perf_counter()
+        if step + 1 < num_steps:
+            # Pull-ahead: fetch batch i+1 while the device runs step i.
+            t_fetch = time.perf_counter()
+            batch = next(it)
+            feed_metrics.observe_wait(time.perf_counter() - t_fetch)
         if log_every and ((step + 1) % log_every == 0 or step + 1 == num_steps):
             # Fetch (blocks on the step stream only here) — ONE device_get
             # for the whole dict, not a per-leaf float() sync each.
             fetched = {
                 k: float(v) for k, v in jax.device_get(metrics).items()
             }
-            dt = time.perf_counter() - t0
-            steps_done = step + 1 - start_step
+            now = time.perf_counter()
+            steps_done = step - start_step  # steady-state steps completed
+            if steps_done > 0:
+                dt = now - t_steady
+            else:
+                # Log fired on the very first step: nothing but the compile
+                # step exists, so report the honest compile-inclusive rate.
+                dt, steps_done = now - t0, 1
             fetched["steps_per_sec"] = steps_done / dt if dt > 0 else 0.0
+            fetched.update(feed_metrics.window())
             if jax.process_index() == 0:
                 logger.info(
                     "step %d: %s",

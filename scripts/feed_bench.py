@@ -1,21 +1,35 @@
-"""Host-side throughput of the native C++ input pipeline (VERDICT r4 #2).
+"""Host-side feed throughput + feed/compute overlap (VERDICT r4 #2, r6 async feed).
 
-Measures what the r4 ImageNet runs never did: batches/s of real
-augmentation work (random-resized-crop + flip + per-channel normalize for
-the ResNet-50 preset; same at 299px for Inception) from a u8 memmap cache,
-with NO TPU in the loop. The dev host for these rounds has exactly ONE
-usable core (os.cpu_count() == 1 — the honest reason r4 leaned on
---device-pool), so the deliverable is per-core img/s plus the core count a
-real TPU VM needs to hit the measured device rates:
+Two measurements, each one JSON line per configuration:
 
-    feed_cores_needed = device_img_per_sec / img_per_sec_per_core
+1. **Raw assembly rate** (``mode: "native"``): batches/s of real
+   augmentation work (random-resized-crop + flip + per-channel normalize)
+   from a u8 memmap cache through the native C++ pipeline, NO TPU in the
+   loop. The dev host for these rounds has exactly ONE usable core
+   (os.cpu_count() == 1 — the honest reason r4 leaned on --device-pool),
+   so the deliverable is per-core img/s plus the core count a real TPU VM
+   needs to hit the measured device rates:
 
-A v5e host exposes ~24 vCPUs per chip (112-vCPU host / 4 chips + OS
-overhead — google cloud docs ct5lp-hightpu-4t), so the question "can the
-feed sustain the device rate" reduces to whether feed_cores_needed fits
-comfortably under ~24.
+       feed_cores_needed = device_img_per_sec / img_per_sec_per_core
+
+   A v5e host exposes ~24 vCPUs per chip (112-vCPU host / 4 chips + OS
+   overhead — google cloud docs ct5lp-hightpu-4t), so the question "can the
+   feed sustain the device rate" reduces to whether feed_cores_needed fits
+   comfortably under ~24.
+
+2. **Feed overlap** (``mode: "overlap"``): the r6 async feed stage
+   (data/prefetch.py) driving a simulated device step (a sleep standing in
+   for an async dispatch stream), prefetch 0 vs N. Reports steady-state
+   host wait per step and **overlap efficiency** — the fraction of host
+   assembly time hidden behind "device" time:
+
+       overlap_efficiency = 1 - mean(host_wait) / mean(assembly)
+
+   ≈ 0 when the feed is synchronous (every assembly millisecond stalls the
+   step stream), → 1 when prefetch fully hides assembly.
 
     python scripts/feed_bench.py [--images 2048] [--batches 20]
+    python scripts/feed_bench.py --quick        # sub-10s, CI-friendly
 """
 
 from __future__ import annotations
@@ -66,23 +80,15 @@ def bench(out_hw: int, images: np.ndarray, labels: np.ndarray, batch: int,
     finally:
         pipe.close()
     img_s = batch * n_batches / dt
-    return {"out": out_hw, "threads": n_threads,
+    return {"mode": "native", "out": out_hw, "threads": n_threads,
             "batches_per_s": round(n_batches / dt, 3),
             "img_per_s": round(img_s, 1)}
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--images", type=int, default=2048)
-    ap.add_argument("--batches", type=int, default=20)
-    ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--src-hw", type=int, default=256)
-    args = ap.parse_args()
-
+def native_section(args) -> None:
     if not native_available():
-        print(json.dumps({"error": "native pipeline unavailable"}))
+        print(json.dumps({"mode": "native", "error": "native pipeline unavailable"}))
         return
-
     cores = len(os.sched_getaffinity(0))
     print(f"host cores available: {cores}")
     rng = np.random.default_rng(0)
@@ -93,6 +99,13 @@ def main():
         arr[:] = rng.integers(0, 256, arr.shape, dtype=np.uint8)
         labels = rng.integers(0, 1000, args.images).astype(np.int32)
 
+        if args.quick:
+            # One small geometry, one thread config: raw rate only (the
+            # cores-needed extrapolation is meaningless at toy sizes).
+            r = bench(64, arr, labels, args.batch, args.batches, cores)
+            r["preset"] = "quick_64"
+            print(json.dumps(r), flush=True)
+            return
         for out_hw, key in ((224, "resnet50_224"), (299, "inception_299")):
             for n_threads in sorted({1, cores}):
                 r = bench(out_hw, arr, labels, args.batch, args.batches,
@@ -105,6 +118,101 @@ def main():
                     "cores_needed_for_device_rate": round(need, 1),
                 })
                 print(json.dumps(r), flush=True)
+
+
+def overlap_section(args) -> None:
+    """Prefetch A/B over the synthetic image pipeline with a simulated
+    device step: sleep(step_ms) stands in for the async dispatch stream
+    (it releases the GIL exactly like a real device step would leave the
+    host idle, so the feeder thread gets the core even on a 1-vCPU box)."""
+    from distributed_tensorflow_tpu.data import (
+        device_batches,
+        prefetch,
+        synthetic_image_classification,
+    )
+    from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+
+    import jax
+
+    mesh = build_mesh({"data": -1})
+    ds = synthetic_image_classification(
+        max(2 * args.batch, 256), (args.overlap_hw, args.overlap_hw, 3),
+        10, seed=0,
+    )
+    for depth in (0, args.prefetch):
+        metrics = FeedMetrics()
+        it = prefetch(
+            device_batches(ds, mesh, args.batch, seed=0), depth,
+            metrics=metrics,
+        )
+        jax.block_until_ready(next(it))  # warm: placement path + feeder
+        # Drop the warmup observation — the first assembly pays one-time
+        # placement setup and would skew the sync path's efficiency.
+        metrics.assembly.reset()
+        metrics.host_wait.reset()
+        t0 = time.perf_counter()
+        for _ in range(args.batches):
+            tw = time.perf_counter()
+            b = next(it)
+            metrics.observe_wait(time.perf_counter() - tw)
+            jax.block_until_ready(b)
+            time.sleep(args.step_ms / 1e3)  # the "device step"
+        dt = time.perf_counter() - t0
+        it.close()
+        asm = metrics.assembly
+        wait = metrics.host_wait
+        asm_mean = asm.total / asm.count if asm.count else 0.0
+        wait_mean = wait.total / wait.count if wait.count else 0.0
+        eff = max(0.0, 1.0 - wait_mean / asm_mean) if asm_mean > 0 else 0.0
+        print(json.dumps({
+            "mode": "overlap",
+            "prefetch": depth,
+            "batch": args.batch,
+            "hw": args.overlap_hw,
+            "step_ms": args.step_ms,
+            "img_per_s": round(args.batch * args.batches / dt, 1),
+            "host_wait_ms_per_step": round(1e3 * wait_mean, 3),
+            "assembly_ms_per_batch": round(1e3 * asm_mean, 3),
+            "overlap_efficiency": round(eff, 3),
+            "batches_assembled": metrics.batches_assembled.value,
+        }), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=2048)
+    ap.add_argument("--batches", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--src-hw", type=int, default=256)
+    ap.add_argument("--quick", action="store_true",
+                    help="sub-10s CI mode: tiny geometry, few batches")
+    ap.add_argument("--skip-native", action="store_true",
+                    help="only run the overlap section")
+    ap.add_argument("--skip-overlap", action="store_true",
+                    help="only run the native assembly section")
+    ap.add_argument("--prefetch", type=int, default=2,
+                    help="prefetch depth for the overlap A/B (vs 0)")
+    ap.add_argument("--step-ms", type=float, default=0.0,
+                    help="simulated device step for the overlap section "
+                    "(default: 20ms, 8ms under --quick)")
+    ap.add_argument("--overlap-hw", type=int, default=0,
+                    help="image size for the overlap section "
+                    "(default: 224, 64 under --quick)")
+    args = ap.parse_args()
+
+    if args.quick:
+        args.images = min(args.images, 256)
+        args.batches = min(args.batches, 8)
+        args.batch = min(args.batch, 64)
+        args.src_hw = min(args.src_hw, 96)
+    args.step_ms = args.step_ms or (8.0 if args.quick else 20.0)
+    args.overlap_hw = args.overlap_hw or (64 if args.quick else 224)
+
+    if not args.skip_native:
+        native_section(args)
+    if not args.skip_overlap:
+        overlap_section(args)
 
 
 if __name__ == "__main__":
