@@ -38,6 +38,7 @@ def build_serving_client(cfg, args):
         build_mesh,
         initialize_runtime,
     )
+    from distributed_tensorflow_tpu.obs.trace import Tracer
     from distributed_tensorflow_tpu.serve import (
         BatcherConfig,
         BertInferenceEngine,
@@ -100,6 +101,9 @@ def build_serving_client(cfg, args):
             ids = rng.integers(5, vocab, size=l)
             return {"input_ids": ids, "mlm_targets": ids}
 
+    # Span tracing is always-on-capable: --trace-buffer 0 turns it into
+    # branch-cheap no-ops at every call site.
+    buf = getattr(args, "trace_buffer", 4096)
     client = Client(
         engine,
         BatcherConfig(
@@ -110,6 +114,7 @@ def build_serving_client(cfg, args):
             bucket_queues=args.bucket_queues,
         ),
         metrics=metrics,
+        tracer=Tracer(buffer_size=buf, enabled=buf > 0),
     )
     return client, make_payload
 
@@ -168,6 +173,13 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--image-size", type=int, default=0)
     parser.add_argument("--staleness", type=int, default=-1,
                         help="training run's staleness (stale-mode ckpts)")
+    parser.add_argument("--trace-dir", default="",
+                        help="where POST /profilez drops jax.profiler "
+                        "captures; also receives a Chrome span trace at "
+                        "shutdown (GET /tracez drains spans live)")
+    parser.add_argument("--trace-buffer", type=int, default=4096,
+                        help="span ring-buffer size (0 disables tracing: "
+                        "every span call becomes a cheap no-op)")
     parser.add_argument("--selftest", type=int, default=0,
                         help="serve N synthetic requests in-process and "
                         "exit (no HTTP socket)")
@@ -193,9 +205,12 @@ def main(argv: list[str] | None = None):
             return _selftest(client, make_payload, args.selftest)
         from distributed_tensorflow_tpu.serve import build_http_server
 
-        server = build_http_server(client, args.host, args.port)
+        server = build_http_server(
+            client, args.host, args.port, trace_dir=args.trace_dir or None
+        )
         logger.info(
-            "ready on http://%s:%d (POST /v1/%s)",
+            "ready on http://%s:%d (POST /v1/%s; GET /statusz /tracez, "
+            "POST /profilez)",
             *server.server_address,
             "classify" if hasattr(client.engine, "image_shape") else "mlm",
         )
@@ -208,6 +223,11 @@ def main(argv: list[str] | None = None):
         return 0
     finally:
         client.close()
+        if args.trace_dir and client.tracer.enabled:
+            from pathlib import Path
+
+            out = client.tracer.export(Path(args.trace_dir) / "serve_trace.json")
+            logger.info("wrote span trace to %s", out)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,7 @@ import argparse
 import dataclasses
 import logging
 from collections.abc import Callable, Iterator
+from pathlib import Path
 from typing import Any
 
 import jax
@@ -780,14 +781,40 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     hook = make_metric_hook(logdir=args.tb_dir, jsonl=args.metrics_jsonl)
     import contextlib
 
+    # Host-side span tracing (obs/trace.py): ring-buffered step-phase
+    # spans, exported as Chrome trace-event JSON at run end. Distinct from
+    # --profile-dir, which captures the DEVICE side via jax.profiler.
+    from distributed_tensorflow_tpu.obs.trace import Tracer
+
+    trace_dir = getattr(args, "trace_dir", "") or ""
+    tracer = (
+        Tracer(buffer_size=getattr(args, "trace_buffer", 4096) or 4096)
+        if trace_dir
+        else None
+    )
+    profile_steps = getattr(args, "profile_steps", 0) or 0
+    if profile_steps and not args.profile_dir:
+        raise SystemExit("--profile-steps requires --profile-dir")
     profile_cm = (
-        trace_steps(args.profile_dir) if args.profile_dir else contextlib.nullcontext()
+        trace_steps(args.profile_dir, num_steps=profile_steps or None)
+        if args.profile_dir
+        else contextlib.nullcontext()
     )
     try:
-        with profile_cm:
+        with profile_cm as win:
+            step_fn = step
+            if profile_steps:
+                # Armed window: the profiler runs for exactly N dispatched
+                # steps instead of the whole run.
+                def step_fn(state_, batch_, rng_):
+                    win.before_step()
+                    out = step(state_, batch_, rng_)
+                    win.after_step(out)
+                    return out
+
             state, last = fit(
                 state,
-                step,
+                step_fn,
                 batches,
                 num_steps=cfg.num_steps,
                 rng=make_rng(args.seed, args.rng_impl),
@@ -798,6 +825,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
                 evaluate=evaluate,
                 eval_every=args.eval_every,
                 feed_metrics=feed_metrics,
+                tracer=tracer,
             )
         if ckpt is not None and ckpt.latest_step() != int(state.step):
             ckpt.save(int(state.step), state, force=True)
@@ -809,6 +837,9 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         close = getattr(batches, "close", None)
         if close is not None:
             close()
+        if tracer is not None and jax.process_index() == 0:
+            out = tracer.export(Path(trace_dir) / "train_trace.json")
+            logging.info("wrote host span trace to %s", out)
     return state, last
 
 
@@ -902,6 +933,18 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--metrics-jsonl", default="")
     parser.add_argument("--profile-dir", default="",
                         help="capture an xprof trace of the whole run to this dir")
+    parser.add_argument("--profile-steps", type=int, default=0,
+                        help="arm the --profile-dir window for exactly N "
+                        "dispatched steps (starts at the first step, stops "
+                        "after the Nth; 0 = trace the whole run)")
+    parser.add_argument("--trace-dir", default="",
+                        help="record host-side step-phase spans (host_wait/"
+                        "dispatch/device/metrics_fetch/checkpoint) and "
+                        "write them here as Chrome trace-event JSON "
+                        "(Perfetto / chrome://tracing)")
+    parser.add_argument("--trace-buffer", type=int, default=4096,
+                        help="span ring-buffer size for --trace-dir (the "
+                        "export holds the most recent N spans)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--rng-impl",

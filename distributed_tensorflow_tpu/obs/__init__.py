@@ -20,4 +20,12 @@ from distributed_tensorflow_tpu.obs.metrics import (  # noqa: F401
     TensorBoardWriter,
     make_metric_hook,
 )
-from distributed_tensorflow_tpu.obs.profile import trace_steps  # noqa: F401
+from distributed_tensorflow_tpu.obs.profile import (  # noqa: F401
+    profile_window,
+    trace_steps,
+)
+from distributed_tensorflow_tpu.obs.trace import (  # noqa: F401
+    NULL_TRACER,
+    Span,
+    Tracer,
+)

@@ -274,6 +274,15 @@ class ServeMetrics:
         self.tier_hits = LabelledCounter()      # dispatches per batch tier
         self.bucket_hits = LabelledCounter()    # dispatches per sequence bucket
         self.tier_occupancy = LabelledHistogram()  # rows per dispatch, by tier
+        # Per-request phase breakdown (seconds), keyed by phase name
+        # (queue_wait/batch_assemble/dispatch/device/fetch on the pipelined
+        # path) — the histogram form of the per-request `Future.phases`
+        # dict, so serve_bench p99 is attributable to a pipeline stage.
+        self.phase = LabelledHistogram()
+        # Requests that never produced a result, by cause: "backpressure"
+        # (queue full), "validation" (RequestError at submit),
+        # "engine_failure" (batch raised mid-flight), "closed".
+        self.rejected_by_cause = LabelledCounter()
 
     def snapshot(self) -> dict:
         lat = self.latency.summary()
@@ -292,6 +301,14 @@ class ServeMetrics:
             "tier_hits": self.tier_hits.snapshot(),
             "bucket_hits": self.bucket_hits.snapshot(),
             "tier_occupancy": self.tier_occupancy.snapshot(),
+            "rejected_by_cause": self.rejected_by_cause.snapshot(),
+            "phase_ms": {
+                phase: {
+                    k: (v * 1e3 if k != "count" else v)
+                    for k, v in summ.items()
+                }
+                for phase, summ in self.phase.snapshot().items()
+            },
         }
 
 

@@ -4,27 +4,135 @@ The reference's per-``sess.run`` ``RunOptions(trace_level=FULL_TRACE)``
 Chrome timeline becomes a ``jax.profiler`` trace window around N steps,
 viewable with TensorBoard's profile plugin — including per-op TPU timing,
 HBM usage, and the ICI collectives the step issues.
+
+Only process 0 traces (same gate as the metric writers — one profile per
+job, not one per host); other processes get a no-op window, so call sites
+stay branch-free. Two capture shapes:
+
+- ``trace_steps(logdir)``: everything dispatched inside the ``with`` block
+  (the original whole-run capture, ``cli/train.py --profile-dir``).
+- ``trace_steps(logdir, num_steps=N)``: an ARMED window — the profiler
+  starts at the first dispatched step and stops after exactly N, blocking
+  on the Nth step's outputs so the device tail lands in the trace
+  (``cli/train.py --profile-steps``). The yielded window's
+  ``before_step()``/``after_step(out)`` bracket each dispatch.
+- :func:`profile_window`: a bounded wall-clock capture for a RUNNING
+  process — what ``POST /profilez?ms=N`` serves. Serialized by a module
+  lock (the jax profiler is a process-global singleton).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
 from pathlib import Path
 
 import jax
 
+# jax.profiler.start_trace/stop_trace drive one global profiler session;
+# concurrent /profilez calls (ThreadingHTTPServer: thread per request) or a
+# profilez hitting during a --profile-steps window must queue, not collide.
+_PROFILER_LOCK = threading.Lock()
+
+
+class _NullWindow:
+    """No-op window: non-chief processes and the plain whole-block mode."""
+
+    def before_step(self) -> None:
+        pass
+
+    def after_step(self, out=None) -> None:
+        pass
+
+
+class _StepWindow:
+    """Armed N-step window: first ``before_step`` starts the trace, the
+    Nth ``after_step`` blocks on its outputs and stops it."""
+
+    def __init__(self, logdir: str, num_steps: int):
+        if num_steps < 1:
+            raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+        self._logdir = logdir
+        self._num_steps = num_steps
+        self._seen = 0
+        self._active = False
+        self._done = False
+
+    def before_step(self) -> None:
+        if self._done or self._active:
+            return
+        _PROFILER_LOCK.acquire()
+        jax.profiler.start_trace(self._logdir)
+        self._active = True
+
+    def after_step(self, out=None) -> None:
+        if not self._active:
+            return
+        self._seen += 1
+        if self._seen >= self._num_steps:
+            if out is not None:
+                jax.block_until_ready(out)
+            self.close()
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            _PROFILER_LOCK.release()
+
 
 @contextlib.contextmanager
-def trace_steps(logdir: str | Path):
-    """Context manager: profile everything dispatched inside the window.
+def trace_steps(logdir: str | Path, num_steps: int | None = None):
+    """Context manager: profile dispatched work, process 0 only.
 
-    Usage::
+    Usage (whole block)::
 
         with trace_steps("/tmp/xprof"):
             for _ in range(5):
                 state, m = train_step(state, next(batches), rng)
             jax.block_until_ready(state.params)
+
+    Usage (armed N-step window)::
+
+        with trace_steps("/tmp/xprof", num_steps=3) as win:
+            for _ in range(100):
+                win.before_step()
+                state, m = train_step(state, next(batches), rng)
+                win.after_step((state, m))   # steps 1..3 land in the trace
     """
+    if jax.process_index() != 0:
+        yield _NullWindow()
+        return
     Path(logdir).mkdir(parents=True, exist_ok=True)
-    with jax.profiler.trace(str(logdir)):
-        yield
+    if num_steps is None:
+        with _PROFILER_LOCK, jax.profiler.trace(str(logdir)):
+            yield _NullWindow()
+        return
+    win = _StepWindow(str(logdir), num_steps)
+    try:
+        yield win
+    finally:
+        win.close()  # run shorter than N steps: stop cleanly anyway
+
+
+def profile_window(logdir: str | Path, ms: float) -> dict:
+    """Capture a bounded ``ms``-long profiler window NOW (live process).
+
+    Blocks the calling thread for the window (the /profilez handler thread,
+    not the serving hot path), clamped to [1 ms, 60 s]. Returns the
+    capture summary the endpoint replies with.
+    """
+    ms = min(max(float(ms), 1.0), 60_000.0)
+    logdir = Path(logdir)
+    logdir.mkdir(parents=True, exist_ok=True)
+    with _PROFILER_LOCK:
+        t0 = time.perf_counter()
+        jax.profiler.start_trace(str(logdir))
+        try:
+            time.sleep(ms / 1e3)
+        finally:
+            jax.profiler.stop_trace()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+    return {"trace_dir": str(logdir), "requested_ms": ms, "wall_ms": wall_ms}

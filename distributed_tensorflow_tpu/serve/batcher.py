@@ -30,6 +30,7 @@ the overlap/bucket hooks are optional keyword callables.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import logging
 import queue
 import threading
@@ -39,6 +40,7 @@ from collections.abc import Callable, Sequence
 from concurrent.futures import Future
 
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.obs.trace import NULL_TRACER
 
 logger = logging.getLogger(__name__)
 
@@ -76,12 +78,14 @@ class BatcherConfig:
 
 
 class _Pending:
-    __slots__ = ("payload", "future", "t_enqueue")
+    __slots__ = ("payload", "future", "t_enqueue", "t_taken", "request_id")
 
-    def __init__(self, payload):
+    def __init__(self, payload, request_id=None):
         self.payload = payload
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
+        self.t_taken = 0.0          # stamped when the flusher takes the batch
+        self.request_id = request_id
 
 
 class DynamicBatcher:
@@ -102,9 +106,12 @@ class DynamicBatcher:
         dispatch: Callable | None = None,
         fetch: Callable | None = None,
         bucket_for: Callable | None = None,
+        tracer=None,
     ):
         self.config = config or BatcherConfig()
         self.metrics = metrics or ServeMetrics()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self._req_ids = itertools.count()
         self._run_batch = run_batch
         self._dispatch = dispatch
         self._fetch = fetch
@@ -132,23 +139,41 @@ class DynamicBatcher:
         )
         self._thread.start()
 
-    def submit(self, payload) -> Future:
+    def submit(self, payload, request_id: str | None = None) -> Future:
         """Enqueue one request; returns its Future (result = engine output).
+
+        ``request_id`` is the trace correlation key: callers (the HTTP
+        front end) pass theirs through; otherwise one is minted here, and
+        either way it rides the request end to end — on the returned
+        Future (``.request_id``, plus ``.phases`` once resolved), in every
+        span the request produces, and in rejection/failure accounting.
 
         Raises :class:`Backpressure` when the queue is at ``max_queue`` —
         the retry-after hint is one max-delay window, the time one flush
-        takes to drain ``max_batch`` slots.
+        takes to drain ``max_batch`` slots. The rejection carries the
+        ``request_id`` so shed load stays attributable in logs.
         """
         key = self._bucket_for(payload) if self._bucket_for else None
+        if request_id is None:
+            request_id = f"r-{next(self._req_ids):08d}"
         with self._cv:
             if self._closed:
+                self.metrics.rejected_by_cause.inc("closed")
                 raise RuntimeError("batcher is closed")
             if self._count >= self.config.max_queue:
                 self.metrics.rejected.inc()
+                self.metrics.rejected_by_cause.inc("backpressure")
+                self.tracer.instant(
+                    "rejected", "serve", request_id=request_id,
+                    cause="backpressure", queue_depth=self._count,
+                )
                 # One flush window, floored at 1 ms so a zero-delay config
                 # still hands clients a usable (non-zero) retry hint.
-                raise Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
-            pending = _Pending(payload)
+                exc = Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
+                exc.request_id = request_id
+                raise exc
+            pending = _Pending(payload, request_id)
+            pending.future.request_id = request_id
             self._queues.setdefault(key, deque()).append(pending)
             self._count += 1
             self.metrics.requests.inc()
@@ -214,15 +239,39 @@ class DynamicBatcher:
                 del self._queues[key]
             self._count -= len(batch)
             self.metrics.queue_depth.set(self._count)
+            now = time.monotonic()
+            for p in batch:
+                p.t_taken = now  # queue_wait phase ends here
             return batch
 
     def _fail(self, batch: list[_Pending], exc: BaseException) -> None:
         self.metrics.errors.inc()
+        self.metrics.rejected_by_cause.inc("engine_failure", len(batch))
         for p in batch:
+            self.tracer.instant(
+                "engine_failure", "serve", request_id=p.request_id,
+                error=type(exc).__name__,
+            )
             if not p.future.cancelled():
                 p.future.set_exception(exc)
+        logger.warning(
+            "batch of %d failed (%s): request_ids=%s",
+            len(batch), type(exc).__name__, [p.request_id for p in batch],
+        )
 
-    def _deliver(self, batch: list[_Pending], results) -> None:
+    def _deliver(self, batch: list[_Pending], results,
+                 marks: list[tuple[str, float]] = (), final_phase="fetch"):
+        """Resolve futures + record the per-request phase breakdown.
+
+        ``marks`` are the batch-level phase boundaries measured by the
+        flusher/completion threads, as ``(phase_name, t_end)`` in dispatch
+        order; each request's first phase is its own ``queue_wait``
+        (enqueue -> taken) and its last (``final_phase``) ends at the
+        delivery timestamp. Boundaries are CONTIGUOUS, so the phase sum
+        equals the measured enqueue->reply latency by construction — the
+        serve_bench tripwire fails loudly if instrumentation ever drifts
+        from that.
+        """
         if len(results) != len(batch):
             # An engine that answers short would leave the excess futures
             # pending FOREVER under a bare zip — fail the whole batch
@@ -236,9 +285,33 @@ class DynamicBatcher:
             )
             return
         now = time.monotonic()
+        tracer, metrics = self.tracer, self.metrics
+        t_taken = batch[0].t_taken  # one flush: all rows taken together
+        if tracer.enabled:
+            t = t_taken
+            for name, t_end in marks:
+                tracer.record(name, t, t_end, cat="serve",
+                              args={"rows": len(batch)})
+                t = t_end
+            tracer.record(final_phase, t, now, cat="serve",
+                          args={"rows": len(batch)})
         for p, r in zip(batch, results):
-            self.metrics.latency.observe(now - p.t_enqueue)
+            latency = now - p.t_enqueue
+            self.metrics.latency.observe(latency)
+            phases = {"queue_wait": p.t_taken - p.t_enqueue}
+            t = p.t_taken
+            for name, t_end in marks:
+                phases[name] = t_end - t
+                t = t_end
+            phases[final_phase] = now - t
+            for name, dt in phases.items():
+                metrics.phase.observe(name, dt)
+            tracer.record("request", p.t_enqueue, now, cat="serve",
+                          request_id=p.request_id)
+            tracer.record("queue_wait", p.t_enqueue, p.t_taken, cat="serve",
+                          request_id=p.request_id)
             if not p.future.cancelled():
+                p.future.phases = phases
                 p.future.set_result(r)
 
     def _loop(self):
@@ -256,7 +329,9 @@ class DynamicBatcher:
                 except Exception as e:  # noqa: BLE001 — fail the batch, not the server
                     self._fail(batch, e)
                     continue
-                self._deliver(batch, results)
+                # Serial path: run_batch blocks through assemble + device +
+                # fetch, so the breakdown collapses to queue_wait -> run.
+                self._deliver(batch, results, final_phase="run")
                 continue
             # Overlapped path: launch, hand off to the completion thread,
             # and immediately assemble the next batch. The semaphore
@@ -268,23 +343,38 @@ class DynamicBatcher:
                 self._inflight_sem.release()
                 self._fail(batch, e)
                 continue
+            t_disp = time.monotonic()
             with self._cv:
                 self._n_inflight += 1
                 self.metrics.in_flight.set(self._n_inflight)
-            self._completion.put((batch, handle))
+            self._completion.put((batch, handle, t_disp))
 
     def _completion_loop(self):
         while True:
             item = self._completion.get()
             if item is None:
                 return
-            batch, handle = item
+            batch, handle, t_disp = item
             try:
                 results = self._fetch(handle)
             except Exception as e:  # noqa: BLE001
                 self._fail(batch, e)
             else:
-                self._deliver(batch, results)
+                # Phase boundaries: real engines stamp t_assembled (host
+                # buffers filled) on dispatch and t_got (device_get
+                # returned) on fetch; handles without them degrade to
+                # coarser-but-still-contiguous boundaries.
+                t_got = getattr(handle, "t_got", None) or time.monotonic()
+                t_asm = getattr(handle, "t_assembled", None) or t_disp
+                self._deliver(
+                    batch,
+                    results,
+                    marks=[
+                        ("batch_assemble", t_asm),
+                        ("dispatch", t_disp),
+                        ("device", t_got),
+                    ],
+                )
             finally:
                 with self._cv:
                     self._n_inflight -= 1

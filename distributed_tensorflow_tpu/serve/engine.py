@@ -37,6 +37,7 @@ import dataclasses
 import logging
 import math
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +95,11 @@ class InFlightBatch:
     n: int              # real rows (the rest of the tier is padding)
     meta: list          # per-row bookkeeping (e.g. unpadded lengths)
     buffers: tuple      # host staging arrays to recycle on fetch
+    # Phase-boundary stamps (time.monotonic) the batcher turns into the
+    # per-request breakdown: host staging buffers filled (ends the
+    # batch_assemble phase) / jax.device_get returned (ends device).
+    t_assembled: float = 0.0
+    t_got: float = 0.0
 
 
 class _AotEngine:
@@ -338,6 +344,7 @@ class BertInferenceEngine(_AotEngine):
             if "mlm_targets" in p:
                 targets[r, :l] = np.asarray(p["mlm_targets"], np.int32)
         mask[len(payloads):, 0] = True
+        t_assembled = time.monotonic()
         out = self._compiled[key](
             self.params,
             self._put(ids, T),
@@ -347,12 +354,14 @@ class BertInferenceEngine(_AotEngine):
         )
         self._record_dispatch(T, L, len(payloads))
         return InFlightBatch(
-            out=out, key=key, n=len(payloads), meta=lens, buffers=buffers
+            out=out, key=key, n=len(payloads), meta=lens, buffers=buffers,
+            t_assembled=t_assembled,
         )
 
     def fetch(self, inflight: InFlightBatch) -> list[dict]:
         """Block on the in-flight batch and slice out per-row results."""
         out = jax.device_get(inflight.out)
+        inflight.t_got = time.monotonic()
         self._give_buffers(inflight.key, inflight.buffers)
         L = inflight.key[1]
         results = []
@@ -447,14 +456,17 @@ class ImageClassifierEngine(_AotEngine):
         imgs.fill(0.0)
         for r, p in enumerate(payloads):
             imgs[r] = np.asarray(p["image"], np.float32)
+        t_assembled = time.monotonic()
         out = self._compiled[T](self.variables, self._put(imgs, T))
         self._record_dispatch(T, None, len(payloads))
         return InFlightBatch(
-            out=out, key=(T,), n=len(payloads), meta=[], buffers=buffers
+            out=out, key=(T,), n=len(payloads), meta=[], buffers=buffers,
+            t_assembled=t_assembled,
         )
 
     def fetch(self, inflight: InFlightBatch) -> list[dict]:
         out = jax.device_get(inflight.out)
+        inflight.t_got = time.monotonic()
         self._give_buffers(inflight.key, inflight.buffers)
         return [
             {"top_ids": out["top_ids"][r], "top_probs": out["top_probs"][r]}

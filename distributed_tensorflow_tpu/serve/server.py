@@ -10,12 +10,27 @@ Routes::
 
     GET  /healthz    -> {"status": "ok", "engine": ...}
     GET  /metrics    -> ServeMetrics.snapshot() as JSON
+    GET  /statusz    -> live status: queue depths, in-flight batches,
+                        tier/bucket occupancy, rejections by cause,
+                        recent-span summary
+    GET  /tracez?spans=N -> drain the span ring buffer as Chrome
+                        trace-event JSON (Perfetto / chrome://tracing)
+    POST /profilez?ms=N -> capture a bounded jax.profiler window on the
+                        RUNNING server (needs trace_dir)
     POST /v1/mlm     -> BERT: pred_ids / score / nsp_probs for one example
     POST /v1/embed   -> BERT: pooled [CLS] embedding for one example
     POST /v1/classify-> image: top-k ids/probs for one example
 
+Every request gets a ``request_id`` (honoring an ``X-Request-Id`` header
+when the client sends one) that rides through the batcher into the engine
+spans and comes back in the response — success bodies also carry
+``phases``, the per-request latency breakdown
+(``queue_wait/batch_assemble/dispatch/device/fetch``, milliseconds).
+
 Error mapping: RequestError -> 400; Backpressure -> 429 + ``Retry-After``;
-anything the engine raises mid-batch -> 500.
+anything the engine raises mid-batch -> 500. All error bodies carry the
+``request_id``, so shed or failed load is attributable in client logs and
+server traces alike.
 """
 
 from __future__ import annotations
@@ -24,10 +39,12 @@ import json
 import logging
 from concurrent.futures import Future
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.obs.trace import Tracer
 from distributed_tensorflow_tpu.serve.batcher import (
     BatcherConfig,
     DynamicBatcher,
@@ -40,16 +57,24 @@ logger = logging.getLogger(__name__)
 class Client:
     """In-process serving client: ``submit`` returns a Future, ``call``
     blocks for the result. Payloads validate BEFORE they enqueue so a
-    malformed request fails alone instead of poisoning its batch."""
+    malformed request fails alone instead of poisoning its batch.
+
+    The resolved Future carries the request's observability sidecar:
+    ``future.request_id`` and ``future.phases`` (the per-phase latency
+    breakdown in seconds) — results themselves stay exactly what the
+    engine returned.
+    """
 
     def __init__(
         self,
         engine,
         config: BatcherConfig | None = None,
         metrics: ServeMetrics | None = None,
+        tracer: Tracer | None = None,
     ):
         self.engine = engine
         self.metrics = metrics or ServeMetrics()
+        self.tracer = tracer if tracer is not None else Tracer()
         if config is None:
             config = BatcherConfig(max_batch=engine.max_batch)
         elif config.max_batch > engine.max_batch:
@@ -79,11 +104,20 @@ class Client:
             dispatch=getattr(engine, "dispatch", None),
             fetch=getattr(engine, "fetch", None),
             bucket_for=bucket_for,
+            tracer=self.tracer,
         )
 
-    def submit(self, payload: dict) -> Future:
-        self.engine.validate(payload)  # RequestError before enqueue
-        return self.batcher.submit(payload)
+    def submit(self, payload: dict, request_id: str | None = None) -> Future:
+        try:
+            self.engine.validate(payload)  # RequestError before enqueue
+        except RequestError:
+            self.metrics.rejected_by_cause.inc("validation")
+            self.tracer.instant(
+                "rejected", "serve", request_id=request_id,
+                cause="validation",
+            )
+            raise
+        return self.batcher.submit(payload, request_id=request_id)
 
     def call(self, payload: dict, timeout: float | None = 60.0) -> dict:
         return self.submit(payload).result(timeout=timeout)
@@ -111,11 +145,19 @@ def _jsonable(obj):
     return obj
 
 
-def build_http_server(client: Client, host: str = "127.0.0.1", port: int = 0):
+def build_http_server(
+    client: Client,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    *,
+    trace_dir: str | None = None,
+):
     """Build (not start) a ``ThreadingHTTPServer`` over ``client``.
 
     ``port=0`` binds an ephemeral port (tests read ``server.server_address``).
-    Call ``serve_forever()`` to run; ``shutdown()`` to stop.
+    Call ``serve_forever()`` to run; ``shutdown()`` to stop. ``trace_dir``
+    is where ``POST /profilez`` drops its ``jax.profiler`` captures (the
+    endpoint answers 503 without one).
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -141,47 +183,117 @@ def build_http_server(client: Client, host: str = "127.0.0.1", port: int = 0):
             self.end_headers()
             self.wfile.write(data)
 
+        def _statusz(self) -> dict:
+            snap = client.metrics.snapshot()
+            tracer = client.tracer
+            return {
+                "engine": type(client.engine).__name__,
+                "queue_depth": snap["queue_depth"],
+                "in_flight": snap["in_flight"],
+                "requests": snap["requests"],
+                "rejected_by_cause": snap["rejected_by_cause"],
+                "errors": snap["errors"],
+                "tier_occupancy": snap["tier_occupancy"],
+                "bucket_hits": snap["bucket_hits"],
+                "phase_ms": snap["phase_ms"],
+                "tracer": tracer.status(),
+                "recent_spans": tracer.summary(),
+            }
+
         def do_GET(self):
-            if self.path == "/healthz":
+            url = urlparse(self.path)
+            if url.path == "/healthz":
                 self._reply(
                     200,
                     {"status": "ok", "engine": type(client.engine).__name__},
                 )
-            elif self.path == "/metrics":
+            elif url.path == "/metrics":
                 self._reply(200, client.metrics.snapshot())
+            elif url.path == "/statusz":
+                self._reply(200, self._statusz())
+            elif url.path == "/tracez":
+                q = parse_qs(url.query)
+                try:
+                    n = int(q["spans"][0]) if "spans" in q else None
+                except ValueError:
+                    self._reply(400, {"error": "spans must be an integer"})
+                    return
+                spans = client.tracer.drain(n)
+                self._reply(200, client.tracer.chrome_json(spans))
             else:
-                self._reply(404, {"error": f"no route {self.path}"})
+                self._reply(404, {"error": f"no route {url.path}"})
+
+        def _profilez(self, url) -> None:
+            if trace_dir is None:
+                self._reply(
+                    503,
+                    {"error": "profiling disabled: server built without "
+                              "trace_dir (pass --trace-dir)"},
+                )
+                return
+            q = parse_qs(url.query)
+            try:
+                ms = float(q["ms"][0]) if "ms" in q else 500.0
+            except ValueError:
+                self._reply(400, {"error": "ms must be a number"})
+                return
+            from distributed_tensorflow_tpu.obs.profile import profile_window
+
+            # Blocks THIS handler thread for the window; the serving hot
+            # path keeps running underneath — that is the point: the
+            # capture sees live traffic.
+            self._reply(200, profile_window(trace_dir, ms))
 
         def do_POST(self):
-            fields = self._routes.get(self.path)
-            if fields is None:
-                self._reply(404, {"error": f"no route {self.path}"})
+            url = urlparse(self.path)
+            if url.path == "/profilez":
+                self._profilez(url)
                 return
+            fields = self._routes.get(url.path)
+            if fields is None:
+                self._reply(404, {"error": f"no route {url.path}"})
+                return
+            rid = self.headers.get("X-Request-Id") or None
+            fut = None
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 if not isinstance(payload, dict):
                     raise RequestError("request body must be a JSON object")
-                result = client.call(payload)
+                fut = client.submit(payload, request_id=rid)
+                rid = getattr(fut, "request_id", rid)
+                result = fut.result(timeout=60.0)
             except RequestError as e:
-                self._reply(400, {"error": str(e)})
+                self._reply(400, {"error": str(e), "request_id": rid})
             except json.JSONDecodeError as e:
-                self._reply(400, {"error": f"bad JSON: {e}"})
+                self._reply(
+                    400, {"error": f"bad JSON: {e}", "request_id": rid}
+                )
             except Exception as e:  # Backpressure or engine failure
+                rid = getattr(e, "request_id", None) or rid
                 retry = getattr(e, "retry_after_s", None)
                 if retry is not None:
                     self._reply(
                         429,
-                        {"error": str(e), "retry_after_s": retry},
+                        {
+                            "error": str(e),
+                            "retry_after_s": retry,
+                            "request_id": rid,
+                        },
                         headers={"Retry-After": f"{retry:.3f}"},
                     )
                 else:
-                    logger.exception("request failed")
-                    self._reply(500, {"error": str(e)})
+                    logger.exception("request %s failed", rid)
+                    self._reply(500, {"error": str(e), "request_id": rid})
             else:
-                self._reply(
-                    200, {k: result[k] for k in fields if k in result}
-                )
+                body = {k: result[k] for k in fields if k in result}
+                body["request_id"] = rid
+                phases = getattr(fut, "phases", None)
+                if phases is not None:
+                    body["phases"] = {
+                        k: v * 1e3 for k, v in phases.items()  # ms
+                    }
+                self._reply(200, body)
 
     server = ThreadingHTTPServer((host, port), Handler)
     logger.info("serving on http://%s:%d", *server.server_address)

@@ -31,6 +31,7 @@ from typing import Any
 import jax
 
 from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
+from distributed_tensorflow_tpu.obs.trace import NULL_TRACER, Tracer
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +53,7 @@ def fit(
     evaluate: Callable[[Any], dict] | None = None,
     eval_every: int = 0,
     feed_metrics: FeedMetrics | None = None,
+    tracer: Tracer | None = None,
 ):
     """Run the training loop; returns the final state.
 
@@ -70,9 +72,19 @@ def fit(
     one place. Logged throughput is **steady-state**: the wall-clock origin
     resets after the first step of the run completes, so step-0
     tracing+compilation never dilutes ``steps_per_sec``.
+
+    ``tracer`` (obs/trace.py) records the per-step phase timeline —
+    ``host_wait`` (blocked on the feed) and ``dispatch`` (handing the step
+    to the device stream) every step, ``device``/``metrics_fetch`` at the
+    log cadence (the only points the loop blocks on device values), plus
+    ``checkpoint_save`` and ``eval`` spans — each carrying its ``step``
+    correlation key. Disabled (the default) it is a no-op context manager
+    per call site, cheap enough to leave in the hot loop.
     """
     if rng is None:
         rng = jax.random.key(0)
+    if tracer is None:
+        tracer = NULL_TRACER
     it: Iterator = iter(data)
     if feed_metrics is None:
         feed_metrics = getattr(data, "metrics", None) or FeedMetrics()
@@ -83,10 +95,12 @@ def fit(
     t0 = time.perf_counter()  # run origin (only used if the run is 1 step)
     t_steady = None           # reset after the first step: excludes compile
     t_fetch = time.perf_counter()
-    batch = next(it)
+    with tracer.span("host_wait", "train", step=start_step):
+        batch = next(it)
     feed_metrics.observe_wait(time.perf_counter() - t_fetch)
     for step in range(start_step, num_steps):
-        state, metrics = train_step(state, batch, rng)
+        with tracer.span("dispatch", "train", step=step):
+            state, metrics = train_step(state, batch, rng)
         if t_steady is None:
             # The first call paid tracing + compilation (dispatch itself is
             # async); everything after this point is the steady-state
@@ -95,14 +109,19 @@ def fit(
         if step + 1 < num_steps:
             # Pull-ahead: fetch batch i+1 while the device runs step i.
             t_fetch = time.perf_counter()
-            batch = next(it)
+            with tracer.span("host_wait", "train", step=step + 1):
+                batch = next(it)
             feed_metrics.observe_wait(time.perf_counter() - t_fetch)
         if log_every and ((step + 1) % log_every == 0 or step + 1 == num_steps):
             # Fetch (blocks on the step stream only here) — ONE device_get
-            # for the whole dict, not a per-leaf float() sync each.
-            fetched = {
-                k: float(v) for k, v in jax.device_get(metrics).items()
-            }
+            # for the whole dict, not a per-leaf float() sync each. The
+            # `device` span is the honest device edge: the blocking wait on
+            # the dispatched step stream; `metrics_fetch` is the host-side
+            # conversion after it.
+            with tracer.span("device", "train", step=step + 1):
+                fetched_dev = jax.device_get(metrics)
+            with tracer.span("metrics_fetch", "train", step=step + 1):
+                fetched = {k: float(v) for k, v in fetched_dev.items()}
             now = time.perf_counter()
             steps_done = step - start_step  # steady-state steps completed
             if steps_done > 0:
@@ -125,10 +144,11 @@ def fit(
         if evaluate is not None and eval_every and (
             (step + 1) % eval_every == 0 or step + 1 == num_steps
         ):
-            ev = {
-                f"eval_{k}": float(v)
-                for k, v in jax.device_get(evaluate(state)).items()
-            }
+            with tracer.span("eval", "train", step=step + 1):
+                ev = {
+                    f"eval_{k}": float(v)
+                    for k, v in jax.device_get(evaluate(state)).items()
+                }
             if jax.process_index() == 0:
                 logger.info(
                     "step %d eval: %s",
@@ -139,5 +159,6 @@ def fit(
                 hook(step + 1, state, ev)
             pending_metrics = {**(pending_metrics or {}), **ev}
         if checkpointer is not None and ckpt_every and (step + 1) % ckpt_every == 0:
-            checkpointer.save(step + 1, state)
+            with tracer.span("checkpoint_save", "train", step=step + 1):
+                checkpointer.save(step + 1, state)
     return state, pending_metrics

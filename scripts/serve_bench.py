@@ -44,6 +44,7 @@ def build_client(args):
         BertConfig,
         BertForPreTraining,
     )
+    from distributed_tensorflow_tpu.obs.trace import Tracer
     from distributed_tensorflow_tpu.serve import (
         BatcherConfig,
         BertInferenceEngine,
@@ -87,6 +88,9 @@ def build_client(args):
         max_batch=args.max_batch,
         batch_tiers=tuple(args.batch_tiers),
     )
+    # Tracing on iff --trace-dir: the same run then doubles as the
+    # enabled-vs-disabled overhead measurement (docs/PERF.md).
+    tracing = bool(args.trace_dir)
     client = Client(
         engine,
         BatcherConfig(
@@ -96,6 +100,7 @@ def build_client(args):
             max_in_flight=args.max_in_flight,
             bucket_queues=args.bucket_queues,
         ),
+        tracer=Tracer(buffer_size=args.trace_buffer, enabled=tracing),
     )
     return client, cfg.vocab_size
 
@@ -188,6 +193,11 @@ def main(argv=None) -> int:
                    help="CI smoke: tiny model, one short load point")
     p.add_argument("--ckpt-dir", default="",
                    help="serve a real checkpoint instead of random init")
+    p.add_argument("--trace-dir", default="",
+                   help="enable span tracing and write the Chrome "
+                   "trace-event JSON (Perfetto-loadable) here")
+    p.add_argument("--trace-buffer", type=int, default=16384,
+                   help="span ring-buffer size when tracing")
     p.add_argument("--json", default="", help="also write results here")
     args = p.parse_args(argv)
 
@@ -231,17 +241,20 @@ def main(argv=None) -> int:
             metrics.batch_occupancy.reset()
             metrics.tier_hits.reset()
             metrics.bucket_hits.reset()
+            metrics.phase.reset()
             padded0 = metrics.padded_rows.value
             batches0 = metrics.batches.value
             r = run_load(client, payloads, rps, args.duration)
             snap = metrics.snapshot()
             r["p50_ms"] = snap["latency_ms"]["p50"]
             r["p99_ms"] = snap["latency_ms"]["p99"]
+            r["mean_ms"] = snap["latency_ms"]["mean"]
             r["mean_batch_occupancy"] = snap["batch_occupancy"]["mean"]
             r["batches"] = snap["batches"] - batches0
             r["padded_rows"] = snap["padded_rows"] - padded0
             r["tier_hits"] = snap["tier_hits"]
             r["bucket_hits"] = snap["bucket_hits"]
+            r["phase_ms"] = snap["phase_ms"]
             rows.append(r)
     finally:
         client.close()
@@ -263,10 +276,60 @@ def main(argv=None) -> int:
             f"{r['mean_batch_occupancy']:>10.2f} "
             f"{r['padded_rows']:>12d}  {tiers}"
         )
+    # ---------------------------------------------- phase attribution
+    # Where the end-to-end latency went, per pipeline phase (the spans'
+    # histogram view). The phase boundaries are contiguous timestamps, so
+    # their means MUST sum to the end-to-end mean — divergence is
+    # instrumentation drift, and --quick treats it as a failure (a
+    # standing CI tripwire).
+    phase_order = [
+        "queue_wait", "batch_assemble", "dispatch", "device", "fetch", "run",
+    ]
+    max_divergence = 0.0
+    print("\nphase attribution (per offered load):")
+    for r in rows:
+        e2e = r["mean_ms"]
+        phases = r["phase_ms"]
+        phase_sum = sum(p["mean"] for p in phases.values())
+        divergence = abs(phase_sum - e2e) / e2e if e2e else 0.0
+        max_divergence = max(max_divergence, divergence)
+        r["phase_sum_ms"] = phase_sum
+        r["phase_divergence"] = divergence
+        print(
+            f"  offered {r['offered_rps']:.0f} rps — e2e mean "
+            f"{e2e:.2f} ms, phase sum {phase_sum:.2f} ms "
+            f"(divergence {100 * divergence:.1f}%)"
+        )
+        hdr = f"    {'phase':>15} {'mean ms':>9} {'p99 ms':>9} {'of e2e':>7}"
+        print(hdr)
+        for name in phase_order:
+            if name not in phases:
+                continue
+            ph = phases[name]
+            frac = ph["mean"] / e2e if e2e else 0.0
+            print(
+                f"    {name:>15} {ph['mean']:>9.2f} {ph['p99']:>9.2f} "
+                f"{100 * frac:>6.1f}%"
+            )
+    report["max_phase_divergence"] = max_divergence
+
+    if args.trace_dir:
+        trace_path = os.path.join(args.trace_dir, "serve_bench_trace.json")
+        client.tracer.export(trace_path)
+        n_events = len(client.tracer.chrome_events())
+        print(f"# wrote {n_events} trace events to {trace_path}")
     if args.json:
         with open(args.json, "w") as fh:
             json.dump(report, fh, indent=2)
         print(f"# wrote {args.json}")
+    if args.quick and max_divergence > 0.25:
+        print(
+            f"FAIL: traced phase sum diverges {100 * max_divergence:.1f}% "
+            "from measured wall latency (>25%) — span instrumentation has "
+            "drifted from the enqueue->reply timestamps",
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 

@@ -1,10 +1,13 @@
-"""Observability tests: metric hooks write; profiler produces a trace."""
+"""Observability tests: metric hooks write; profiler produces a trace;
+ServeMetrics phase/rejection families; armed profiling windows."""
 
 import json
 
 import jax
 
 from distributed_tensorflow_tpu.obs import JsonlWriter, make_metric_hook, trace_steps
+from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.obs.profile import profile_window
 
 
 def test_jsonl_writer(tmp_path):
@@ -39,3 +42,48 @@ def test_trace_steps_writes_profile(tmp_path):
         jax.block_until_ready(jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)))
     produced = list((tmp_path / "prof").rglob("*"))
     assert any(p.is_file() for p in produced), produced
+
+
+def test_trace_steps_armed_window_stops_after_n(tmp_path):
+    """num_steps=N arms the window: only the before/after-bracketed steps
+    land; the window stops itself after N even if the loop keeps going."""
+    with trace_steps(tmp_path / "prof", num_steps=2) as win:
+        for _ in range(5):
+            win.before_step()
+            out = jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8))
+            win.after_step(out)
+        assert win._done  # stopped at step 2, not at context exit
+    produced = list((tmp_path / "prof").rglob("*"))
+    assert any(p.is_file() for p in produced), produced
+
+
+def test_trace_steps_gated_on_process_zero(tmp_path, monkeypatch):
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    logdir = tmp_path / "prof"
+    with trace_steps(logdir, num_steps=2) as win:
+        win.before_step()
+        win.after_step()
+    assert not logdir.exists()  # non-chief: no dir, no files, no profiler
+
+
+def test_profile_window_bounded_capture(tmp_path):
+    res = profile_window(tmp_path / "pw", ms=20)
+    assert res["wall_ms"] >= 20.0
+    assert res["requested_ms"] == 20.0
+    assert any(p.is_file() for p in (tmp_path / "pw").rglob("*"))
+
+
+def test_serve_metrics_phase_and_rejection_families():
+    m = ServeMetrics()
+    m.phase.observe("queue_wait", 0.002)
+    m.phase.observe("queue_wait", 0.004)
+    m.phase.observe("device", 0.010)
+    m.rejected_by_cause.inc("backpressure")
+    m.rejected_by_cause.inc("engine_failure", 3)
+    snap = m.snapshot()
+    assert snap["phase_ms"]["queue_wait"]["count"] == 2
+    assert abs(snap["phase_ms"]["queue_wait"]["mean"] - 3.0) < 1e-6  # ms
+    assert snap["phase_ms"]["device"]["max"] >= 10.0
+    assert snap["rejected_by_cause"] == {
+        "backpressure": 1, "engine_failure": 3,
+    }
