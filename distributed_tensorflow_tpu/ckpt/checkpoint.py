@@ -93,9 +93,14 @@ def restore_serving_state(directory: str | Path, template_state: Any):
 
     ``template_state`` is a TrainState built exactly like the training run's
     (same optimizer/staleness, so the pytree structure matches the saved
-    one); its arrays may carry SERVING placements — tensorstore reshards on
-    read, so a TP/PP-sharded training checkpoint restores cleanly onto a
-    replicated single-host serving mesh. Returns ``(params, model_state,
+    one); its arrays carry the SERVING placements — tensorstore reshards on
+    read, in either direction: a TP/PP-sharded training checkpoint restores
+    cleanly onto a replicated single-host serving mesh, and a template built
+    for a mesh-sharded serving layout (``place_state`` with the engine's
+    ``bert_param_specs``-derived state specs, cli/serve.py) has every shard
+    read DIRECTLY into its target device — no single-device staging
+    round-trip, so restore memory stays bounded by one shard per chip even
+    for models too big for one chip. Returns ``(params, model_state,
     step)``. Raises ``FileNotFoundError`` when the directory holds no
     checkpoint: serving must never silently answer from random init.
     """

@@ -2,12 +2,22 @@
 
 Serve a trained checkpoint behind the dynamic micro-batcher: rebuild the
 workload's model exactly as training did (same preset + overrides), restore
-the newest checkpoint from ``--ckpt-dir`` onto a DP-only serving mesh,
+the newest checkpoint from ``--ckpt-dir`` directly onto the serving mesh,
 AOT-compile the forward per sequence bucket / image geometry, and expose it
 over HTTP (serve/server.py routes).
 
+The serving mesh defaults to DP-only (one chip per replica). ``--tp`` /
+``--pp`` / ``--ep`` (or an explicit ``--mesh data=2,model=4``) shard each
+BERT engine across that many chips — Megatron tensor parallelism,
+GPipe pipeline stages, expert-parallel MoE — with the remainder going to
+data parallelism. The restore template carries the target layout's
+shardings, so the checkpoint reads straight into place with no
+single-device staging. A mesh that doesn't fit the available devices
+degrades to single-chip DP with a warning, never an XLA shape error.
+
 The config flags MUST match the training run's — the checkpoint template is
-rebuilt from them (same optimizer, same staleness), and a mismatched tree
+rebuilt from them (same optimizer, same staleness; for pipeline/MoE runs
+also ``--pp`` / ``--moe-experts`` / ``--moe-topk``), and a mismatched tree
 fails loudly at restore rather than serving garbage.
 
 ``--selftest N`` runs N synthetic requests through the in-process
@@ -27,6 +37,33 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def _resolve_mesh_spec(args, n_devices: int):
+    """Serving mesh spec from ``--mesh`` / ``--tp/--pp/--ep`` -> (spec,
+    fell_back). Requests that cannot fit ``n_devices`` degrade to
+    single-chip DP with a warning — never an XLA shape error at startup."""
+    from distributed_tensorflow_tpu.parallel.mesh import MeshSpec
+    from distributed_tensorflow_tpu.serve.engine import plan_serve_mesh
+
+    if args.mesh:
+        try:
+            spec = {}
+            for part in args.mesh.split(","):
+                name, _, size = part.partition("=")
+                spec[name.strip()] = int(size)
+            MeshSpec(spec).resolve(n_devices)  # loud fit check, result unused
+            return spec, False
+        except ValueError as e:
+            logger.warning(
+                "--mesh %r does not fit the %d available devices (%s); "
+                "falling back to single-chip data-parallel serving",
+                args.mesh, n_devices, e,
+            )
+            return {"data": -1}, True
+    return plan_serve_mesh(
+        tp=args.tp, pp=args.pp, ep=args.ep, n_devices=n_devices
+    )
+
+
 def build_serving_client(cfg, args):
     """Workload config -> (Client, payload_maker) over the restored ckpt."""
     import jax
@@ -36,6 +73,7 @@ def build_serving_client(cfg, args):
     from distributed_tensorflow_tpu.obs import ServeMetrics
     from distributed_tensorflow_tpu.parallel.mesh import (
         build_mesh,
+        data_axes,
         initialize_runtime,
     )
     from distributed_tensorflow_tpu.obs.trace import Tracer
@@ -46,17 +84,35 @@ def build_serving_client(cfg, args):
         ImageClassifierEngine,
     )
     from distributed_tensorflow_tpu.train import create_train_state
-    from distributed_tensorflow_tpu.train.step import place_state
+    from distributed_tensorflow_tpu.train.step import (
+        make_state_specs,
+        place_state,
+    )
 
     initialize_runtime()
-    # Serving mesh is DP-only: the workload builders see no seq/model/
-    # expert/pipeline axes and hand back the axis-free model; tensorstore
-    # reshards the (possibly TP/PP-sharded) checkpoint onto it at restore.
-    mesh = build_mesh({"data": -1})
+    # Serving mesh: DP-only by default; --mesh/--tp/--pp/--ep add model
+    # axes (BERT engines shard over them; see serve/engine.py). The
+    # builders hand back the axis-free model either way — the engine binds
+    # the axes itself — plus param_specs when the layout shards params.
+    spec, _ = _resolve_mesh_spec(args, len(jax.devices()))
+    mesh = build_mesh(spec)
     pieces = cfg.build(cfg)(mesh)
+    if "image_shape" in pieces and set(mesh.axis_names) - set(data_axes(mesh)):
+        # Model parallelism is a BERT feature: an image config on a mesh
+        # with model axes would just compute redundantly across them —
+        # rebuild DP-only instead of silently wasting the chips.
+        logger.warning(
+            "--tp/--pp/--ep apply to BERT configs only; serving %s "
+            "data-parallel", cfg.name,
+        )
+        mesh = build_mesh({"data": -1})
+        pieces = cfg.build(cfg)(mesh)
 
     # The restore template: a TrainState built exactly like training's
-    # (same tx -> same opt_state slots, same staleness -> same grad ring).
+    # (same tx -> same opt_state slots, same staleness -> same grad ring),
+    # placed in the TARGET serving layout — param_specs present means the
+    # mesh shards params, and tensorstore then restores every shard
+    # directly into place (no single-device staging round-trip).
     tx, _ = _make_tx(cfg)
     host_state = create_train_state(
         pieces["params"],
@@ -64,9 +120,15 @@ def build_serving_client(cfg, args):
         pieces["model_state"],
         staleness=cfg.staleness if cfg.mode == "stale" else 0,
     )
-    template = place_state(host_state, mesh, None)
+    state_specs = None
+    if pieces.get("param_specs") is not None:
+        state_specs = make_state_specs(host_state, tx, pieces["param_specs"])
+    template = place_state(host_state, mesh, state_specs)
     params, model_state, step = restore_serving_state(args.ckpt_dir, template)
-    logger.info("restored %s step %d for serving", cfg.name, step)
+    logger.info(
+        "restored %s step %d for serving (mesh %s)",
+        cfg.name, step, dict(mesh.shape),
+    )
 
     metrics = ServeMetrics()
     if "image_shape" in pieces:
@@ -166,6 +228,31 @@ def main(argv: list[str] | None = None):
                         help="queue bound; beyond -> 429 + Retry-After")
     parser.add_argument("--top-k", type=int, default=5,
                         help="classes returned per classify request")
+    # Multi-chip serving mesh (BERT engines; see DEPLOY.md "Multi-chip
+    # serving"). A layout that doesn't fit the device count falls back to
+    # single-chip DP with a warning.
+    parser.add_argument("--mesh", default="",
+                        help="explicit serving mesh, e.g. 'data=2,model=4' "
+                        "(axes from parallel.mesh.AXIS_ORDER; one axis may "
+                        "be -1). Overrides --tp/--pp/--ep")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel (Megatron) chips per engine; "
+                        "must divide num_heads and intermediate_size")
+    parser.add_argument("--pp", type=int, default=1,
+                        help="pipeline-parallel stages per engine; the "
+                        "checkpoint must be a --pipeline-parallel=N run "
+                        "(stacked encoder)")
+    parser.add_argument("--ep", type=int, default=1,
+                        help="expert-parallel chips per engine; needs a "
+                        "--moe-experts checkpoint divisible by it")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="training run's --moe-experts (MoE ckpts)")
+    parser.add_argument("--moe-topk", type=int, default=1,
+                        help="training run's --moe-topk")
+    parser.add_argument("--global-batch", type=int, default=0,
+                        help="training run's --global-batch (only needed "
+                        "when the preset default doesn't match, e.g. "
+                        "pipeline runs validating microbatch divisibility)")
     # Model-geometry overrides — MUST match the training run's.
     parser.add_argument("--bert-layers", type=int, default=0)
     parser.add_argument("--bert-hidden", type=int, default=0)
@@ -190,9 +277,17 @@ def main(argv: list[str] | None = None):
     )
     cfg = PRESETS[args.config]
     overrides = {}
-    for k in ("bert_layers", "bert_hidden", "bert_vocab", "image_size"):
+    for k in ("bert_layers", "bert_hidden", "bert_vocab", "image_size",
+              "global_batch"):
         if getattr(args, k):
             overrides[k] = getattr(args, k)
+    if args.moe_experts:
+        overrides["moe_experts"] = args.moe_experts
+        overrides["moe_topk"] = args.moe_topk
+    if args.pp > 1:
+        # Stacked-encoder checkpoints need the stacked template even when
+        # the mesh falls back to no pipeline axis (sequential scan).
+        overrides["pipeline_parallel"] = args.pp
     if args.staleness >= 0:
         overrides["staleness"] = args.staleness
         overrides["mode"] = "stale" if args.staleness else "sync"

@@ -397,6 +397,21 @@ def _build_bert_workload(cfg_kwargs: dict):
                     pipeline_parallel=pp,
                     pipeline_microbatches=micro,
                 )
+            elif cfg.pipeline_parallel > 1:
+                # No pipeline mesh axis but a pipeline-trained config: the
+                # SERVING fallback path (cli/serve.py restoring a stacked
+                # checkpoint onto a mesh without the axis, e.g. single-chip
+                # degradation). Stacked params with the axis unset run the
+                # sequential scan — mathematically identical to the GPipe
+                # schedule, so one checkpoint restores either way. Training
+                # never lands here: run() always puts the axis on the mesh
+                # when cfg.pipeline_parallel > 1.
+                init_cfg = dataclasses.replace(
+                    init_cfg, pipeline_parallel=cfg.pipeline_parallel
+                )
+                model_cfg = dataclasses.replace(
+                    model_cfg, pipeline_parallel=cfg.pipeline_parallel
+                )
             if cfg.remat:
                 # Training model only — init's one forward needs no remat,
                 # and the param tree is identical either way.
@@ -479,10 +494,12 @@ def _build_bert_workload(cfg_kwargs: dict):
 
             return {
                 "params": variables["params"],
-                # Serving hook (cli/serve.py): the axis-free model — serving
-                # meshes are DP-only, so the engine wants the same module
-                # init used (no seq/model/pipeline axes bound; stacked
-                # pipeline params run the sequential scan).
+                # Serving hook (cli/serve.py): the axis-free model, exactly
+                # as init used it (no seq/model/pipeline axes bound; stacked
+                # pipeline params run the sequential scan). On a mesh WITH
+                # model axes the serving engine re-binds them itself
+                # (BertInferenceEngine._serve_config) — param_specs below
+                # carries the matching sharding contract.
                 "model": init_model_,
                 "param_specs": (
                     bert_param_specs(

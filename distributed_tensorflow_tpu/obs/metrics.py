@@ -274,15 +274,34 @@ class ServeMetrics:
         self.tier_hits = LabelledCounter()      # dispatches per batch tier
         self.bucket_hits = LabelledCounter()    # dispatches per sequence bucket
         self.tier_occupancy = LabelledHistogram()  # rows per dispatch, by tier
+        # Layout-labelled twins of the dispatch instruments, keyed
+        # "<layout>/<tier|bucket>" (layout = parallel.mesh.layout_label, e.g.
+        # "dp2-tp4") — ADDITIVE alongside the unlabelled ones so single-mesh
+        # deployments keep their stable /metrics keys while multi-layout
+        # fleets can attribute hits per mesh layout.
+        self.layout_tier_hits = LabelledCounter()
+        self.layout_bucket_hits = LabelledCounter()
         # Per-request phase breakdown (seconds), keyed by phase name
         # (queue_wait/batch_assemble/dispatch/device/fetch on the pipelined
         # path) — the histogram form of the per-request `Future.phases`
         # dict, so serve_bench p99 is attributable to a pipeline stage.
         self.phase = LabelledHistogram()
+        # Per-layout phase histograms, keyed "<layout>/<phase>" — written by
+        # observe_phase alongside the plain phase family, so mesh layouts'
+        # device-time distributions are separable (a TP engine's "device"
+        # phase includes its psums; the DP engine's does not).
+        self.layout_phase = LabelledHistogram()
         # Requests that never produced a result, by cause: "backpressure"
         # (queue full), "validation" (RequestError at submit),
         # "engine_failure" (batch raised mid-flight), "closed".
         self.rejected_by_cause = LabelledCounter()
+
+    def observe_phase(self, name: str, seconds: float, layout: str = "") -> None:
+        """Record one per-request phase sample, double-keyed by the engine's
+        mesh layout when one is known (serve/batcher.py passes it through)."""
+        self.phase.observe(name, seconds)
+        if layout:
+            self.layout_phase.observe(f"{layout}/{name}", seconds)
 
     def snapshot(self) -> dict:
         lat = self.latency.summary()
@@ -301,6 +320,8 @@ class ServeMetrics:
             "tier_hits": self.tier_hits.snapshot(),
             "bucket_hits": self.bucket_hits.snapshot(),
             "tier_occupancy": self.tier_occupancy.snapshot(),
+            "layout_tier_hits": self.layout_tier_hits.snapshot(),
+            "layout_bucket_hits": self.layout_bucket_hits.snapshot(),
             "rejected_by_cause": self.rejected_by_cause.snapshot(),
             "phase_ms": {
                 phase: {
@@ -308,6 +329,13 @@ class ServeMetrics:
                     for k, v in summ.items()
                 }
                 for phase, summ in self.phase.snapshot().items()
+            },
+            "layout_phase_ms": {
+                key: {
+                    k: (v * 1e3 if k != "count" else v)
+                    for k, v in summ.items()
+                }
+                for key, summ in self.layout_phase.snapshot().items()
             },
         }
 

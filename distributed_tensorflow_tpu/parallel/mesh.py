@@ -199,6 +199,35 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in ("replica", "data") if a in mesh.axis_names)
 
 
+# Short axis tags for layout labels, keyed by the canonical axis names.
+_AXIS_SHORT = {
+    "replica": "rep",
+    "data": "dp",
+    "pipeline": "pp",
+    "expert": "ep",
+    "seq": "sp",
+    "model": "tp",
+}
+
+
+def layout_label(mesh: Mesh) -> str:
+    """Compact human/metric-label tag for a mesh layout.
+
+    Size-1 axes are dropped (they change no sharding): ``{"data": 2,
+    "model": 4}`` -> ``"dp2-tp4"``; a single-device mesh -> ``"single"``.
+    Used as the serving engines' layout identity — it keys the
+    layout-labelled ServeMetrics instruments and the serve_bench per-layout
+    report, so it must be stable across runs (it is: axis order is the
+    mesh's, which ``build_mesh`` derives from ``AXIS_ORDER``).
+    """
+    parts = [
+        f"{_AXIS_SHORT.get(a, a)}{mesh.shape[a]}"
+        for a in mesh.axis_names
+        if mesh.shape[a] > 1
+    ]
+    return "-".join(parts) or "single"
+
+
 def batch_pspec(mesh: Mesh) -> P:
     """The canonical batch PartitionSpec: leading dim over the DP axes.
 

@@ -28,5 +28,6 @@ from distributed_tensorflow_tpu.serve.engine import (  # noqa: F401
     ImageClassifierEngine,
     InFlightBatch,
     RequestError,
+    plan_serve_mesh,
 )
 from distributed_tensorflow_tpu.serve.server import Client, build_http_server  # noqa: F401

@@ -107,10 +107,14 @@ class DynamicBatcher:
         fetch: Callable | None = None,
         bucket_for: Callable | None = None,
         tracer=None,
+        layout: str = "",
     ):
         self.config = config or BatcherConfig()
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # The engine's mesh-layout label; keys the per-layout phase
+        # histograms (ServeMetrics.layout_phase). Empty = unlabelled.
+        self._layout = layout
         self._req_ids = itertools.count()
         self._run_batch = run_batch
         self._dispatch = dispatch
@@ -260,7 +264,8 @@ class DynamicBatcher:
         )
 
     def _deliver(self, batch: list[_Pending], results,
-                 marks: list[tuple[str, float]] = (), final_phase="fetch"):
+                 marks: list[tuple[str, float]] = (), final_phase="fetch",
+                 layout: str | None = None):
         """Resolve futures + record the per-request phase breakdown.
 
         ``marks`` are the batch-level phase boundaries measured by the
@@ -270,8 +275,12 @@ class DynamicBatcher:
         delivery timestamp. Boundaries are CONTIGUOUS, so the phase sum
         equals the measured enqueue->reply latency by construction — the
         serve_bench tripwire fails loudly if instrumentation ever drifts
-        from that.
+        ``layout`` labels the per-layout phase twins (defaults to the
+        batcher's engine layout; an in-flight handle that knows better —
+        e.g. a mesh-sharded dispatch — overrides per batch).
         """
+        if layout is None:
+            layout = self._layout
         if len(results) != len(batch):
             # An engine that answers short would leave the excess futures
             # pending FOREVER under a bare zip — fail the whole batch
@@ -305,7 +314,7 @@ class DynamicBatcher:
                 t = t_end
             phases[final_phase] = now - t
             for name, dt in phases.items():
-                metrics.phase.observe(name, dt)
+                metrics.observe_phase(name, dt, layout)
             tracer.record("request", p.t_enqueue, now, cat="serve",
                           request_id=p.request_id)
             tracer.record("queue_wait", p.t_enqueue, p.t_taken, cat="serve",
@@ -374,6 +383,7 @@ class DynamicBatcher:
                         ("dispatch", t_disp),
                         ("device", t_got),
                     ],
+                    layout=getattr(handle, "layout", "") or self._layout,
                 )
             finally:
                 with self._cv:

@@ -20,15 +20,23 @@ batcher exploits the split to pipeline batch k+1's host assembly against
 batch k's device compute (``max_in_flight``). ``run_batch`` remains the
 blocking composition of the two for direct callers.
 
-Placement mirrors training: params live replicated on the serving mesh
-(the DP-only analog of ``place_state``), batches shard their leading dim
-over the data axes when ``max_batch`` divides the DP width and fall back
-to replicated otherwise — a 7-row flush must degrade to redundant compute,
-never to a shape error.
+Placement mirrors training: on a DP-only mesh params live replicated (the
+serving analog of ``place_state``); on a mesh with ``model`` / ``expert`` /
+``pipeline`` axes the BERT engine shards them with the SAME
+``bert_param_specs`` contract training uses, and every executable in the
+grid becomes a ``shard_map`` of the forward over those bound axes —
+Megatron TP attention/FFN, replicated-dispatch expert-parallel MoE, and
+the GPipe schedule all reuse the train-side module code unchanged. The
+grid is therefore (batch tier x bucket x mesh layout): one engine serves
+one layout (``layout_label``), and the layout rides every dispatch into
+the metrics. Batches shard their leading dim over the data axes when the
+tier divides the DP width and fall back to replicated otherwise — a 7-row
+flush must degrade to redundant compute, never to a shape error.
 
 Checkpoints come from training via :func:`ckpt.restore_serving_state`: the
-template TrainState rebuilds the training structure, tensorstore reshards
-sharded arrays onto the serving mesh on read.
+template TrainState rebuilds the training structure and carries the TARGET
+layout's shardings, so tensorstore reads each shard straight into place —
+no single-device staging round-trip.
 """
 
 from __future__ import annotations
@@ -43,10 +51,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from distributed_tensorflow_tpu.parallel.mesh import (
     batch_sharding,
     build_mesh,
     data_axes,
+    layout_label,
     replicated_sharding,
 )
 
@@ -55,6 +66,45 @@ logger = logging.getLogger(__name__)
 
 class RequestError(ValueError):
     """A malformed or un-servable request (maps to HTTP 400, not 500)."""
+
+
+def plan_serve_mesh(
+    tp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    n_devices: int | None = None,
+) -> tuple[dict, bool]:
+    """Serving-mesh spec for the requested model parallelism, with graceful
+    degradation: returns ``(spec, fell_back)``.
+
+    The model axes need ``tp * pp * ep`` devices and the remainder goes to
+    data parallelism, so the product must divide the device count. When it
+    does not (dev box with fewer chips than the production flags assume),
+    serving falls back to single-chip-per-replica DP with a warning —
+    a wrong-sized ``--tp`` must degrade to slower serving, never die in an
+    XLA shape error at startup.
+    """
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    need = max(tp, 1) * max(pp, 1) * max(ep, 1)
+    if need <= 1:
+        return {"data": -1}, False
+    if need > n_devices or n_devices % need:
+        logger.warning(
+            "requested serving mesh (tp=%d pp=%d ep=%d) needs %d devices "
+            "to divide the %d available; falling back to single-chip "
+            "data-parallel serving",
+            tp, pp, ep, need, n_devices,
+        )
+        return {"data": -1}, True
+    spec = {"data": -1}
+    if pp > 1:
+        spec["pipeline"] = pp
+    if ep > 1:
+        spec["expert"] = ep
+    if tp > 1:
+        spec["model"] = tp
+    return spec, False
 
 
 def _batch_sharding_or_replicated(mesh, max_batch: int):
@@ -95,6 +145,9 @@ class InFlightBatch:
     n: int              # real rows (the rest of the tier is padding)
     meta: list          # per-row bookkeeping (e.g. unpadded lengths)
     buffers: tuple      # host staging arrays to recycle on fetch
+    # Mesh layout the batch was dispatched on (``out`` holds refs sharded
+    # per that layout); the batcher keys per-layout phase histograms on it.
+    layout: str = ""
     # Phase-boundary stamps (time.monotonic) the batcher turns into the
     # per-request breakdown: host staging buffers filled (ends the
     # batch_assemble phase) / jax.device_get returned (ends device).
@@ -115,6 +168,7 @@ class _AotEngine:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.mesh = mesh if mesh is not None else build_mesh({"data": -1})
+        self.layout = layout_label(self.mesh)
         self.max_batch = max_batch
         self.batch_tiers = _normalize_tiers(batch_tiers, max_batch)
         self.metrics = None
@@ -160,13 +214,25 @@ class _AotEngine:
         with self._buf_lock:
             self._buf_pool.setdefault(key, []).append(buffers)
 
+    def mesh_info(self) -> dict:
+        """Mesh topology digest (``GET /statusz``): which layout this engine
+        serves, the axis sizes behind it, and the chips one batch spans."""
+        return {
+            "layout": self.layout,
+            "mesh_shape": dict(self.mesh.shape),
+            "devices_per_engine": int(self.mesh.size),
+            "platform": self.mesh.devices.flat[0].platform,
+        }
+
     def _record_dispatch(self, tier: int, bucket, n: int) -> None:
         m = self.metrics
         if m is None:
             return
         m.tier_hits.inc(tier)
+        m.layout_tier_hits.inc(f"{self.layout}/{tier}")
         if bucket is not None:
             m.bucket_hits.inc(bucket)
+            m.layout_bucket_hits.inc(f"{self.layout}/{bucket}")
         m.tier_occupancy.observe(tier, n)
         m.padded_rows.inc(tier - n)
 
@@ -177,75 +243,13 @@ class _AotEngine:
         return self.fetch(self.dispatch(payloads))
 
 
-class BertInferenceEngine(_AotEngine):
-    """MLM scoring / masked-token prediction / sentence embedding over a
-    trained :class:`BertForPreTraining` checkpoint.
+def _make_bert_forward(model, return_logits: bool):
+    """The serving forward for one model variant (closure, not a method:
+    per-tier pipeline variants each need their own)."""
 
-    Request payload (numpy, one example per request):
-
-    - ``input_ids``: ``[l]`` int — already-tokenized ids, ``l`` <= the
-      largest bucket. Positions holding the MASK id are what
-      ``pred_ids`` answers for.
-    - ``token_type_ids``: optional ``[l]`` int (default zeros).
-    - ``mlm_targets``: optional ``[l]`` int, ``-1`` = unscored. When any
-      position is >= 0 the response carries ``score`` — the mean log-prob
-      of the targets (MLM pseudo-log-likelihood), the standard
-      BERT-as-scorer surface.
-
-    Response per request: ``pred_ids [l]`` (argmax token at every
-    position), ``score`` (float or None), ``embedding [H]`` (pooled [CLS]),
-    ``nsp_probs [2]``, ``bucket`` (the padded length actually run).
-    """
-
-    def __init__(
-        self,
-        model,
-        params,
-        mesh=None,
-        *,
-        buckets: tuple[int, ...] = (128, 256, 512),
-        max_batch: int = 8,
-        batch_tiers: tuple[int, ...] | None = None,
-        return_logits: bool = False,
-    ):
-        super().__init__(mesh, max_batch, batch_tiers)
-        self.model = model
-        cfg = model.cfg
-        self.buckets = tuple(
-            sorted({min(int(b), cfg.max_position) for b in buckets})
-        )
-        if not self.buckets:
-            raise ValueError("need at least one sequence bucket")
-        self.return_logits = return_logits
-        self.params = self._place(params)
-        # AOT-compile one executable per (batch tier, sequence bucket) NOW:
-        # startup pays every trace/compile, the request path pays none (jit
-        # cache lookups included — these are Compiled objects, not jit
-        # wrappers). A partial flush dispatches at the smallest tier that
-        # fits instead of padding to max_batch.
-        self._compiled = {}
-        for T in self.batch_tiers:
-            for L in self.buckets:
-                b = (T, L)
-                self._compiled[T, L] = (
-                    jax.jit(self._forward)
-                    .lower(
-                        self.params,
-                        self._struct(b, jnp.int32, T),
-                        self._struct(b, jnp.bool_, T),
-                        self._struct(b, jnp.int32, T),
-                        self._struct(b, jnp.int32, T),
-                    )
-                    .compile()
-                )
-        logger.info(
-            "BERT engine ready: buckets=%s tiers=%s (%d executables)",
-            self.buckets, self.batch_tiers, len(self._compiled),
-        )
-
-    def _forward(self, params, input_ids, attention_mask, token_type_ids,
-                 mlm_targets):
-        mlm_logits, nsp_logits, pooled = self.model.apply(
+    def forward(params, input_ids, attention_mask, token_type_ids,
+                mlm_targets):
+        mlm_logits, nsp_logits, pooled = model.apply(
             {"params": params},
             input_ids,
             attention_mask,
@@ -272,9 +276,198 @@ class BertInferenceEngine(_AotEngine):
             "embedding": pooled.astype(jnp.float32),
             "nsp_probs": jax.nn.softmax(nsp_logits, axis=-1),
         }
-        if self.return_logits:
+        if return_logits:
             out["mlm_logits"] = mlm_logits
         return out
+
+    return forward
+
+
+class BertInferenceEngine(_AotEngine):
+    """MLM scoring / masked-token prediction / sentence embedding over a
+    trained :class:`BertForPreTraining` checkpoint.
+
+    Request payload (numpy, one example per request):
+
+    - ``input_ids``: ``[l]`` int — already-tokenized ids, ``l`` <= the
+      largest bucket. Positions holding the MASK id are what
+      ``pred_ids`` answers for.
+    - ``token_type_ids``: optional ``[l]`` int (default zeros).
+    - ``mlm_targets``: optional ``[l]`` int, ``-1`` = unscored. When any
+      position is >= 0 the response carries ``score`` — the mean log-prob
+      of the targets (MLM pseudo-log-likelihood), the standard
+      BERT-as-scorer surface.
+
+    Response per request: ``pred_ids [l]`` (argmax token at every
+    position), ``score`` (float or None), ``embedding [H]`` (pooled [CLS]),
+    ``nsp_probs [2]``, ``bucket`` (the padded length actually run).
+
+    Mesh layouts: pass a DP-only mesh (or None) and the engine behaves as
+    before — replicated params, plain-jit executables. Pass a mesh carrying
+    ``model`` / ``expert`` / ``pipeline`` axes and the engine becomes
+    model-parallel: params shard per ``bert_param_specs`` (the training
+    contract, so ``restore_serving_state`` can place a checkpoint straight
+    into this layout) and every (tier, bucket) executable is a
+    ``shard_map`` of the forward — Megatron TP (``num_heads`` and
+    ``intermediate_size`` must divide by the axis size), replicated-
+    dispatch expert-parallel MoE (``moe_experts`` must divide), and the
+    GPipe pipeline (the model must already be the STACKED
+    ``pipeline_parallel == axis size`` variant; microbatches re-derive per
+    tier since GPipe needs M | batch). Numerics match the single-chip
+    engine to the tolerances pinned by tests/test_serve_mesh.py.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        mesh=None,
+        *,
+        buckets: tuple[int, ...] = (128, 256, 512),
+        max_batch: int = 8,
+        batch_tiers: tuple[int, ...] | None = None,
+        return_logits: bool = False,
+    ):
+        super().__init__(mesh, max_batch, batch_tiers)
+        tp = self.mesh.shape.get("model", 1)
+        ep = self.mesh.shape.get("expert", 1)
+        pp = self.mesh.shape.get("pipeline", 1)
+        self._model_sharded = tp > 1 or ep > 1 or pp > 1
+        serve_cfg = self._serve_config(model.cfg, tp, ep, pp)
+        self.model = (
+            type(model)(serve_cfg) if serve_cfg is not model.cfg else model
+        )
+        cfg = self.model.cfg
+        self.buckets = tuple(
+            sorted({min(int(b), cfg.max_position) for b in buckets})
+        )
+        if not self.buckets:
+            raise ValueError("need at least one sequence bucket")
+        self.return_logits = return_logits
+        if self._model_sharded:
+            from distributed_tensorflow_tpu.models.bert import bert_param_specs
+
+            # The same spec tree training shards by (test_bert_tp.py /
+            # test_bert_pp.py pin it) — when restore_serving_state already
+            # placed the checkpoint into this layout, the device_put in
+            # _place is a per-array no-op (no staging round-trip).
+            self._param_specs = bert_param_specs(
+                params,
+                model_axis="model" if tp > 1 else None,
+                expert_axis="expert" if ep > 1 else None,
+                pipeline_axis="pipeline" if pp > 1 else None,
+            )
+            self._param_sharding = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._param_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+        else:
+            self._param_specs = None
+        self.params = self._place(params)
+        # AOT-compile one executable per (batch tier, sequence bucket) NOW:
+        # startup pays every trace/compile, the request path pays none (jit
+        # cache lookups included — these are Compiled objects, not jit
+        # wrappers). A partial flush dispatches at the smallest tier that
+        # fits instead of padding to max_batch.
+        self._compiled = {}
+        for T in self.batch_tiers:
+            fwd = self._tier_forward(T)
+            for L in self.buckets:
+                b = (T, L)
+                self._compiled[T, L] = (
+                    jax.jit(fwd)
+                    .lower(
+                        self.params,
+                        self._struct(b, jnp.int32, T),
+                        self._struct(b, jnp.bool_, T),
+                        self._struct(b, jnp.int32, T),
+                        self._struct(b, jnp.int32, T),
+                    )
+                    .compile()
+                )
+        logger.info(
+            "BERT engine ready: layout=%s buckets=%s tiers=%s (%d executables)",
+            self.layout, self.buckets, self.batch_tiers, len(self._compiled),
+        )
+
+    @staticmethod
+    def _serve_config(cfg, tp: int, ep: int, pp: int):
+        """Bind the model config to the mesh's model axes, validating the
+        same divisibility contracts training enforces — loudly, at startup,
+        never as a shape error mid-request."""
+        if tp > 1:
+            if cfg.num_heads % tp or cfg.intermediate_size % tp:
+                raise ValueError(
+                    f"model axis of {tp} must divide num_heads "
+                    f"({cfg.num_heads}) and intermediate_size "
+                    f"({cfg.intermediate_size})"
+                )
+            cfg = dataclasses.replace(
+                cfg, model_axis="model", model_parallel=tp
+            )
+        if ep > 1:
+            if not cfg.moe_experts or cfg.moe_experts % ep:
+                raise ValueError(
+                    f"expert axis of {ep} needs a MoE model with "
+                    f"moe_experts divisible by it (got {cfg.moe_experts})"
+                )
+            # Replicated dispatch: every expert shard routes the full batch
+            # and partial outputs psum — exact, and free of the capacity
+            # a2a's batch-layout requirements (serving batches are tiny).
+            cfg = dataclasses.replace(
+                cfg,
+                expert_axis="expert",
+                expert_parallel=ep,
+                moe_dispatch="replicated",
+            )
+        if pp > 1:
+            if cfg.pipeline_parallel != pp:
+                raise ValueError(
+                    f"pipeline axis of {pp} needs the stacked "
+                    f"pipeline_parallel={pp} model/checkpoint (got "
+                    f"pipeline_parallel={cfg.pipeline_parallel}); pass the "
+                    "training run's --pipeline-parallel to cli/serve"
+                )
+            cfg = dataclasses.replace(cfg, pipeline_axis="pipeline")
+        return cfg
+
+    def _tier_forward(self, tier: int):
+        """Build the function to compile for one batch tier: the plain
+        forward on a DP-only mesh, or its ``shard_map`` over the model axes
+        (the TP/EP/PP module code runs psums that need bound axes)."""
+        cfg = self.model.cfg
+        model = self.model
+        if self._model_sharded and cfg.pipeline_axis is not None:
+            # GPipe needs n_microbatches | rows, and inside shard_map the
+            # pipeline sees the PER-SHARD rows (tier/dp when the tier is
+            # dp-sharded — must mirror _batch_sharding_or_replicated): per
+            # tier, the largest M dividing both the local rows and the
+            # configured M (gcd; a 1-row shard runs M=1 — bubble-heavy but
+            # correct).
+            dp = math.prod(self.mesh.shape[a] for a in data_axes(self.mesh))
+            local = tier // dp if dp > 1 and tier % dp == 0 else tier
+            m = math.gcd(
+                local, cfg.pipeline_microbatches or 4 * cfg.pipeline_parallel
+            )
+            model = type(model)(
+                dataclasses.replace(cfg, pipeline_microbatches=m)
+            )
+        fwd = _make_bert_forward(model, self.return_logits)
+        if not self._model_sharded:
+            return fwd
+        # Batch spec matches the tier's placement rule: sharded over the DP
+        # axes when the tier divides them, replicated otherwise. All inputs
+        # and every output leaf are leading-dim-batch, so one spec serves
+        # as prefix for both sides; params use the bert_param_specs tree.
+        bspec = self._tier_sharding[tier].spec
+        return jax.shard_map(
+            fwd,
+            mesh=self.mesh,
+            in_specs=(self._param_specs, bspec, bspec, bspec, bspec),
+            out_specs=bspec,
+            check_vma=False,
+        )
 
     def bucket_for(self, length: int) -> int:
         for b in self.buckets:
@@ -355,7 +548,7 @@ class BertInferenceEngine(_AotEngine):
         self._record_dispatch(T, L, len(payloads))
         return InFlightBatch(
             out=out, key=key, n=len(payloads), meta=lens, buffers=buffers,
-            t_assembled=t_assembled,
+            layout=self.layout, t_assembled=t_assembled,
         )
 
     def fetch(self, inflight: InFlightBatch) -> list[dict]:
@@ -461,7 +654,7 @@ class ImageClassifierEngine(_AotEngine):
         self._record_dispatch(T, None, len(payloads))
         return InFlightBatch(
             out=out, key=(T,), n=len(payloads), meta=[], buffers=buffers,
-            t_assembled=t_assembled,
+            layout=self.layout, t_assembled=t_assembled,
         )
 
     def fetch(self, inflight: InFlightBatch) -> list[dict]:

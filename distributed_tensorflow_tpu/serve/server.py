@@ -105,6 +105,7 @@ class Client:
             fetch=getattr(engine, "fetch", None),
             bucket_for=bucket_for,
             tracer=self.tracer,
+            layout=getattr(engine, "layout", ""),
         )
 
     def submit(self, payload: dict, request_id: str | None = None) -> Future:
@@ -186,8 +187,12 @@ def build_http_server(
         def _statusz(self) -> dict:
             snap = client.metrics.snapshot()
             tracer = client.tracer
+            mesh_info = getattr(client.engine, "mesh_info", None)
             return {
                 "engine": type(client.engine).__name__,
+                # Mesh topology digest: layout label, axis sizes, devices
+                # one batch spans (None for stub engines without a mesh).
+                "mesh": mesh_info() if callable(mesh_info) else None,
                 "queue_depth": snap["queue_depth"],
                 "in_flight": snap["in_flight"],
                 "requests": snap["requests"],
@@ -195,6 +200,7 @@ def build_http_server(
                 "errors": snap["errors"],
                 "tier_occupancy": snap["tier_occupancy"],
                 "bucket_hits": snap["bucket_hits"],
+                "layout_tier_hits": snap["layout_tier_hits"],
                 "phase_ms": snap["phase_ms"],
                 "tracer": tracer.status(),
                 "recent_spans": tracer.summary(),

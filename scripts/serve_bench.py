@@ -21,12 +21,27 @@ executable instead of a max-batch-row one).
     python scripts/serve_bench.py --batch-tiers 8        # fixed-batch baseline
     python scripts/serve_bench.py --bucket-queues --json results.json
     python scripts/serve_bench.py --quick                # CI smoke (~seconds)
+
+Mesh-compare mode (--mesh-layouts) replaces the load sweep: the SAME
+model/params serve under several mesh layouts (``single``, ``dp``, and
+dash-joined ``tpN``/``ppN``/``epN`` combos, e.g. ``tp2`` or ``tp2-ep2``),
+each layout gets a numerics parity probe against the first layout (the
+fast-path tolerances) plus closed-loop and one open-loop throughput point,
+and the table reports per-replica throughput and padded rows per layout.
+Layouts that do not fit the host (device count, head/expert/layer
+divisibility) are skipped with a note, not failed — except under
+``--quick``, where a parity mismatch or a throughput collapse vs the
+baseline layout exits nonzero (the CI regression tripwire).
+
+    python scripts/serve_bench.py --mesh-layouts single dp tp2 tp4
+    python scripts/serve_bench.py --quick --mesh-layouts single tp2
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -36,7 +51,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def build_client(args):
+def _build_model(args, *, pipeline_parallel: int = 1):
+    """Tiny bench model + random-init params, shared across engines so
+    mesh-compare layouts provably serve identical weights."""
     import jax
     import jax.numpy as jnp
 
@@ -44,13 +61,13 @@ def build_client(args):
         BertConfig,
         BertForPreTraining,
     )
-    from distributed_tensorflow_tpu.obs.trace import Tracer
-    from distributed_tensorflow_tpu.serve import (
-        BatcherConfig,
-        BertInferenceEngine,
-        Client,
-    )
 
+    extra = {}
+    if pipeline_parallel > 1:
+        extra["pipeline_parallel"] = pipeline_parallel  # stacked encoder
+    if args.moe_experts:
+        extra["moe_experts"] = args.moe_experts
+        extra["moe_topk"] = 1
     cfg = BertConfig(
         vocab_size=args.vocab,
         hidden_size=args.hidden,
@@ -58,6 +75,7 @@ def build_client(args):
         num_heads=max(2, args.hidden // 16),
         intermediate_size=4 * args.hidden,
         max_position=max(args.buckets),
+        **extra,
     )
     model = BertForPreTraining(cfg)
     L = cfg.max_position
@@ -68,7 +86,18 @@ def build_client(args):
         jnp.zeros((1, L), jnp.int32),
         train=False,
     )
-    params = variables["params"]
+    return cfg, model, variables["params"]
+
+
+def build_client(args):
+    from distributed_tensorflow_tpu.obs.trace import Tracer
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        BertInferenceEngine,
+        Client,
+    )
+
+    cfg, model, params = _build_model(args)
     if args.ckpt_dir:
         # Serve real weights: restore expects the training template; the
         # bench only rebuilds bare params, so accept plain-SGD runs here.
@@ -166,6 +195,205 @@ def run_load(client, payloads, offered_rps: float, duration_s: float) -> dict:
     }
 
 
+def _parse_layout(name: str) -> dict | None:
+    """``single``/``dp``/dash-joined ``(tp|pp|ep)N`` tokens -> knob dict."""
+    import re
+
+    knobs = {"tp": 1, "pp": 1, "ep": 1}
+    if name in ("single", "dp"):
+        return knobs
+    for tok in name.split("-"):
+        m = re.fullmatch(r"(tp|pp|ep)(\d+)", tok)
+        if m is None:
+            return None
+        knobs[m.group(1)] = int(m.group(2))
+    return knobs
+
+
+def _probe_parity(baseline: list[dict], got: list[dict]) -> bool:
+    """Fast-path tolerances (tests/test_serve_fastpath.py): pred_ids exact,
+    score rtol 1e-4, embedding/nsp rtol 1e-3 atol 1e-4."""
+    try:
+        for a, b in zip(baseline, got):
+            np.testing.assert_array_equal(a["pred_ids"], b["pred_ids"])
+            np.testing.assert_allclose(a["score"], b["score"], rtol=1e-4)
+            np.testing.assert_allclose(
+                a["embedding"], b["embedding"], rtol=1e-3, atol=1e-4
+            )
+            np.testing.assert_allclose(
+                a["nsp_probs"], b["nsp_probs"], rtol=1e-3, atol=1e-4
+            )
+    except AssertionError as e:
+        print(f"# parity mismatch: {str(e).splitlines()[0]}", file=sys.stderr)
+        return False
+    return True
+
+
+def run_mesh_compare(args) -> int:
+    """Serve the same weights under each requested mesh layout; compare
+    numerics against the first layout and throughput across all of them."""
+    import jax
+
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh, data_axes
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        BertInferenceEngine,
+        Client,
+        plan_serve_mesh,
+    )
+
+    n_dev = len(jax.devices())
+    layouts = []
+    pps = set()
+    for name in args.mesh_layouts:
+        knobs = _parse_layout(name)
+        if knobs is None:
+            print(f"# skip {name}: unrecognized (single|dp|tpN[-ppN][-epN])")
+            continue
+        layouts.append((name, knobs))
+        if knobs["pp"] > 1:
+            pps.add(knobs["pp"])
+    if len(pps) > 1:
+        print("FAIL: mesh layouts mix different pp degrees; the stacked "
+              "encoder is built once and must match them all",
+              file=sys.stderr)
+        return 2
+    if len(layouts) < 2:
+        print("FAIL: --mesh-layouts needs >=2 recognized layouts to compare",
+              file=sys.stderr)
+        return 2
+
+    pp_model = pps.pop() if pps else 1
+    cfg, model, params = _build_model(args, pipeline_parallel=pp_model)
+    payloads = make_payloads(cfg.vocab_size, args.buckets)
+    probes = payloads[:4]
+    load_rps = args.loads[0]
+
+    rows, baseline_out, baseline_rps = [], None, None
+    for name, knobs in layouts:
+        if name == "single":
+            mesh = build_mesh({"data": 1}, devices=jax.devices()[:1])
+        else:
+            spec, fell_back = plan_serve_mesh(
+                tp=knobs["tp"], pp=knobs["pp"], ep=knobs["ep"],
+                n_devices=n_dev,
+            )
+            if fell_back:
+                print(f"# skip {name}: needs "
+                      f"{knobs['tp'] * knobs['pp'] * knobs['ep']} devices, "
+                      f"host has {n_dev}")
+                continue
+            mesh = build_mesh(spec)
+        try:
+            engine = BertInferenceEngine(
+                model, params, mesh,
+                buckets=tuple(args.buckets),
+                max_batch=args.max_batch,
+                batch_tiers=tuple(args.batch_tiers),
+            )
+        except ValueError as e:  # head/expert/layer divisibility
+            print(f"# skip {name}: {e}")
+            continue
+        probe_out = [engine.run_batch([p])[0] for p in probes]
+        parity_ok = True
+        if baseline_out is None:
+            baseline_out = probe_out
+        else:
+            parity_ok = _probe_parity(baseline_out, probe_out)
+        client = Client(engine, BatcherConfig(
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
+            max_in_flight=args.max_in_flight,
+        ))
+        metrics = client.metrics
+        try:
+            for f in [client.submit(payloads[i]) for i in range(8)]:
+                f.result(timeout=120)
+            single = run_single_stream(
+                client, payloads, max(args.single_duration, 0.5)
+            )
+            metrics.latency.reset()
+            padded0 = metrics.padded_rows.value
+            load = run_load(client, payloads, load_rps, args.duration)
+            snap = metrics.snapshot()
+        finally:
+            client.close()
+        replicas = math.prod(
+            mesh.shape[a] for a in data_axes(mesh)
+        ) if data_axes(mesh) else 1
+        if baseline_rps is None:
+            baseline_rps = single["rps"]
+        rows.append({
+            "layout": engine.layout,
+            "requested": name,
+            "devices": int(mesh.size),
+            "replicas": replicas,
+            "parity_ok": parity_ok,
+            "single_rps": single["rps"],
+            "single_rps_per_replica": single["rps"] / replicas,
+            "achieved_rps": load["achieved_rps"],
+            "rps_per_replica": load["achieved_rps"] / replicas,
+            "p50_ms": snap["latency_ms"]["p50"],
+            "p99_ms": snap["latency_ms"]["p99"],
+            "padded_rows": snap["padded_rows"] - padded0,
+            "layout_tier_hits": snap["layout_tier_hits"],
+        })
+
+    hdr = (
+        f"{'layout':>14} {'devs':>5} {'reps':>5} {'single rps':>11} "
+        f"{'load rps':>9} {'rps/rep':>8} {'p50 ms':>8} {'p99 ms':>8} "
+        f"{'padded':>7} {'parity':>7}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(
+            f"{r['layout']:>14} {r['devices']:>5d} {r['replicas']:>5d} "
+            f"{r['single_rps']:>11.1f} {r['achieved_rps']:>9.1f} "
+            f"{r['rps_per_replica']:>8.1f} {r['p50_ms']:>8.2f} "
+            f"{r['p99_ms']:>8.2f} {r['padded_rows']:>7d} "
+            f"{'ok' if r['parity_ok'] else 'FAIL':>7}"
+        )
+
+    report = {
+        "mode": "mesh_compare",
+        "config": {
+            "n_devices": n_dev,
+            "buckets": list(args.buckets),
+            "batch_tiers": list(args.batch_tiers),
+            "max_batch": args.max_batch,
+            "load_rps": load_rps,
+        },
+        "mesh_layouts": rows,
+    }
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+        print(f"# wrote {args.json}")
+
+    bad_parity = [r["layout"] for r in rows if not r["parity_ok"]]
+    if bad_parity:
+        print(f"FAIL: parity vs {rows[0]['layout']} broken for "
+              f"{', '.join(bad_parity)}", file=sys.stderr)
+        return 1
+    if args.quick and baseline_rps:
+        # Regression tripwire, not a speedup assertion: model-parallel on a
+        # simulated-CPU mesh is legitimately slower than single-chip, but a
+        # >20x collapse means a layout is broken (e.g. re-tracing per call).
+        slow = [r["layout"] for r in rows
+                if r["single_rps"] < 0.05 * baseline_rps]
+        if slow:
+            print(f"FAIL: throughput collapse (<5% of "
+                  f"{rows[0]['layout']}) for {', '.join(slow)}",
+                  file=sys.stderr)
+            return 1
+    if len(rows) < 2:
+        print("FAIL: fewer than 2 layouts actually ran", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--loads", type=float, nargs="+", default=[50.0, 200.0],
@@ -191,6 +419,12 @@ def main(argv=None) -> int:
                    "(0 disables it)")
     p.add_argument("--quick", action="store_true",
                    help="CI smoke: tiny model, one short load point")
+    p.add_argument("--mesh-layouts", nargs="+", default=[],
+                   help="compare serving mesh layouts instead of the load "
+                   "sweep: single|dp|tpN[-ppN][-epN] (first is the parity "
+                   "baseline)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="MoE expert count for epN layouts (0 = dense FFN)")
     p.add_argument("--ckpt-dir", default="",
                    help="serve a real checkpoint instead of random init")
     p.add_argument("--trace-dir", default="",
@@ -207,6 +441,9 @@ def main(argv=None) -> int:
         args.single_duration = min(args.single_duration, 0.5)
         args.buckets = [16, 32]
         args.layers, args.hidden, args.vocab = 1, 32, 128
+
+    if args.mesh_layouts:
+        return run_mesh_compare(args)
 
     client, vocab = build_client(args)
     payloads = make_payloads(vocab, args.buckets)

@@ -1,0 +1,350 @@
+"""Mesh-sharded serving tests: model-parallel engines over the tiered AOT
+grid (the multi-chip serving tentpole).
+
+The parity tests mirror tests/test_serve_fastpath.py's numeric checks: the
+SAME weights served through a mesh-sharded engine (tensor-parallel,
+expert-parallel MoE, pipeline-parallel) must answer within the fast-path
+tolerances of the single-chip engine — pred_ids exactly, scores at 1e-4,
+embeddings/nsp at 1e-3. The rest pins the plumbing the tentpole added:
+``plan_serve_mesh`` fallback, layout-labelled metrics through the Client,
+``/statusz`` mesh topology, the CLI's graceful single-chip degradation,
+and serve_bench's mesh-compare mode. Everything runs on the 8 simulated
+CPU devices from conftest.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.serve import (
+    BatcherConfig,
+    BertInferenceEngine,
+    Client,
+    build_http_server,
+    plan_serve_mesh,
+)
+
+# ----------------------------------------------------------- tiny helpers
+
+
+def _tiny_cfg(**overrides):
+    """num_heads=4 / intermediate=64 so tp in {2, 4} divides both."""
+    from distributed_tensorflow_tpu.models.bert import BertConfig
+
+    kw = dict(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=1,
+        num_heads=4,
+        intermediate_size=64,
+        max_position=32,
+        dropout_rate=0.0,
+    )
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+def _init_model(cfg):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.bert import BertForPreTraining
+
+    model = BertForPreTraining(cfg)
+    L = cfg.max_position
+    variables = model.init(
+        jax.random.key(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+        jnp.zeros((1, L), jnp.int32),
+        train=False,
+    )
+    return model, variables["params"]
+
+
+def _payloads(n=4, vocab=64):
+    rng = np.random.default_rng(0)
+    return [
+        {"input_ids": ids, "mlm_targets": ids}
+        for ids in (
+            rng.integers(5, vocab, size=int(l))
+            for l in rng.integers(6, 31, size=n)
+        )
+    ]
+
+
+def _engine(model, params, mesh):
+    # Two executables per engine (tiers 1 and 4, one bucket) keeps the
+    # compile bill small while still exercising both batch-placement
+    # rules: tier 1 replicates rows, tier 4 shards them over dp.
+    return BertInferenceEngine(
+        model, params, mesh, buckets=(32,), max_batch=4, batch_tiers=(1, 4)
+    )
+
+
+def _single_chip_mesh():
+    import jax
+
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+
+    return build_mesh({"data": 1}, devices=jax.devices()[:1])
+
+
+def _assert_parity(ref, got):
+    """tests/test_serve_fastpath.py tolerances."""
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a["pred_ids"], b["pred_ids"])
+        np.testing.assert_allclose(a["score"], b["score"], rtol=1e-4)
+        np.testing.assert_allclose(
+            a["embedding"], b["embedding"], rtol=1e-3, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            a["nsp_probs"], b["nsp_probs"], rtol=1e-3, atol=1e-4
+        )
+        assert a["bucket"] == b["bucket"]
+
+
+def _check_engine_parity(single, sharded, payloads):
+    """Lone request (replicated rows) and full tier (dp-sharded rows)."""
+    for n in (1, 4):
+        _assert_parity(
+            single.run_batch(payloads[:n]), sharded.run_batch(payloads[:n])
+        )
+
+
+# ------------------------------------------------ mesh planning + labels
+
+
+def test_plan_serve_mesh_fits_and_falls_back(caplog):
+    assert plan_serve_mesh() == ({"data": -1}, False)
+    assert plan_serve_mesh(tp=4, n_devices=8) == (
+        {"data": -1, "model": 4},
+        False,
+    )
+    assert plan_serve_mesh(tp=2, pp=2, ep=2, n_devices=8) == (
+        {"data": -1, "pipeline": 2, "expert": 2, "model": 2},
+        False,
+    )
+    # Oversized or non-dividing requests degrade to single-chip dp with a
+    # warning — never an XLA shape error at startup.
+    with caplog.at_level(logging.WARNING):
+        assert plan_serve_mesh(tp=16, n_devices=8) == ({"data": -1}, True)
+        assert plan_serve_mesh(tp=3, n_devices=8) == ({"data": -1}, True)
+    assert "falling back" in caplog.text
+
+
+def test_layout_labels(devices8):
+    from distributed_tensorflow_tpu.parallel.mesh import (
+        build_mesh,
+        layout_label,
+    )
+
+    assert layout_label(_single_chip_mesh()) == "single"
+    assert layout_label(build_mesh({"data": -1})) == "dp8"
+    assert layout_label(build_mesh({"data": 2, "model": 4})) == "dp2-tp4"
+    assert (
+        layout_label(build_mesh({"data": 2, "expert": 2, "model": 2}))
+        == "dp2-ep2-tp2"
+    )
+
+
+# ------------------------------------------------- sharded-engine parity
+
+
+def test_tp_engine_matches_single_chip(devices8):
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+
+    model, params = _init_model(_tiny_cfg())
+    single = _engine(model, params, _single_chip_mesh())
+    tp = _engine(model, params, build_mesh({"data": 2, "model": 4}))
+    assert tp.layout == "dp2-tp4"
+    assert tp.mesh_info() == {
+        "layout": "dp2-tp4",
+        "mesh_shape": {"data": 2, "model": 4},
+        "devices_per_engine": 8,
+        "platform": "cpu",
+    }
+    _check_engine_parity(single, tp, _payloads())
+
+
+def test_ep_moe_engine_matches_single_chip(devices8):
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+
+    cfg = _tiny_cfg(moe_experts=4, moe_topk=1)
+    model, params = _init_model(cfg)
+    single = _engine(model, params, _single_chip_mesh())
+    ep = _engine(model, params, build_mesh({"data": 2, "expert": 4}))
+    assert ep.layout == "dp2-ep4"
+    # Serving forces the all-gather dispatch mode: every shard routes all
+    # tokens and psums partial expert outputs — no capacity-drop jitter.
+    assert ep.model.cfg.moe_dispatch == "replicated"
+    _check_engine_parity(single, ep, _payloads())
+
+
+def test_pp_engine_matches_single_chip(devices8):
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+
+    cfg = _tiny_cfg(num_layers=2, pipeline_parallel=2)
+    model, params = _init_model(cfg)
+    # Single-chip side runs the SAME stacked encoder sequentially (the
+    # scan), the mesh side runs it as a GPipe schedule over the pipeline
+    # axis; per-tier microbatching must divide the PER-SHARD rows.
+    single = _engine(model, params, _single_chip_mesh())
+    pp = _engine(model, params, build_mesh({"data": 4, "pipeline": 2}))
+    assert pp.layout == "dp4-pp2"
+    _check_engine_parity(single, pp, _payloads())
+
+
+def test_serve_config_validation(devices8):
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+
+    model, params = _init_model(_tiny_cfg())
+    with pytest.raises(ValueError, match="num_heads"):
+        _engine(model, params, build_mesh({"data": 1, "model": 8}))
+    with pytest.raises(ValueError, match="moe_experts"):
+        _engine(model, params, build_mesh({"data": 2, "expert": 4}))
+    with pytest.raises(ValueError, match="pipeline-parallel"):
+        _engine(model, params, build_mesh({"data": 4, "pipeline": 2}))
+
+
+# -------------------------------------- layout-labelled observability
+
+
+def test_client_layout_metrics_and_statusz(devices8):
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+
+    model, params = _init_model(_tiny_cfg())
+    eng = _engine(model, params, build_mesh({"data": 4, "model": 2}))
+    m = ServeMetrics()
+    payloads = _payloads(6)
+    with Client(
+        eng, BatcherConfig(max_batch=4, max_delay_ms=2.0), metrics=m
+    ) as client:
+        server = build_http_server(client, port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            for f in [client.submit(p) for p in payloads]:
+                f.result(timeout=60)
+            base = "http://{}:{}".format(*server.server_address)
+            with urllib.request.urlopen(base + "/statusz", timeout=10) as r:
+                status = json.loads(r.read())
+        finally:
+            server.shutdown()
+            thread.join(timeout=10)
+
+    # Every dispatch was recorded under the engine's layout label...
+    snap = m.snapshot()
+    assert set(snap["layout_tier_hits"]) <= {"dp4-tp2/1", "dp4-tp2/4"}
+    assert sum(snap["layout_tier_hits"].values()) == sum(
+        snap["tier_hits"].values()
+    )
+    assert any(k.startswith("dp4-tp2/") for k in snap["layout_bucket_hits"])
+    # ...and the phase histograms exist per layout too.
+    assert any(
+        k.startswith("dp4-tp2/") for k in snap["layout_phase_ms"]
+    ), snap["layout_phase_ms"].keys()
+    # /statusz answers the mesh topology digest.
+    assert status["mesh"] == eng.mesh_info()
+    assert status["layout_tier_hits"] == snap["layout_tier_hits"]
+    eng.metrics = None
+
+
+# -------------------------------------------- CLI degradation + mesh path
+
+_TINY_BERT_FLAGS = [
+    "--bert-layers=1",
+    "--bert-hidden=32",
+    "--bert-vocab=64",
+]
+
+
+@pytest.fixture(scope="module")
+def trained_tiny_ckpt(tmp_path_factory, devices8):
+    from distributed_tensorflow_tpu.cli.train import main as train_main
+
+    ckpt_dir = tmp_path_factory.mktemp("serve_mesh_ckpt") / "ck"
+    rc = train_main(
+        [
+            "--config=bert_base",
+            "--steps=2",
+            "--global-batch=8",
+            "--log-every=1",
+            f"--ckpt-dir={ckpt_dir}",
+            *_TINY_BERT_FLAGS,
+        ]
+    )
+    assert rc == 0
+    return ckpt_dir
+
+
+def _serve_selftest(ckpt_dir, *extra):
+    from distributed_tensorflow_tpu.cli.serve import main as serve_main
+
+    return serve_main(
+        [
+            "--config=bert_base",
+            f"--ckpt-dir={ckpt_dir}",
+            *_TINY_BERT_FLAGS,
+            "--buckets", "16",
+            "--max-batch=2",
+            "--max-delay-ms=2",
+            "--selftest=3",
+            *extra,
+        ]
+    )
+
+
+def test_cli_selftest_tp_mesh(trained_tiny_ckpt):
+    """Checkpoint restores DIRECTLY into the TP layout and serves."""
+    assert _serve_selftest(trained_tiny_ckpt, "--tp=2") == 0
+
+
+def test_cli_selftest_oversized_mesh_falls_back(trained_tiny_ckpt, caplog):
+    """A mesh that exceeds the host degrades to single-chip serving with a
+    warning — the selftest must still answer, not die in XLA."""
+    with caplog.at_level(logging.WARNING):
+        assert _serve_selftest(trained_tiny_ckpt, "--tp=16") == 0
+    assert "falling back" in caplog.text
+
+
+# ------------------------------------------------- serve_bench mesh mode
+
+
+def _import_serve_bench():
+    scripts = str(Path(__file__).resolve().parents[1] / "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import serve_bench
+
+    return serve_bench
+
+
+def test_serve_bench_quick_mesh(tmp_path, devices8):
+    """--quick --mesh-layouts: parity gate + per-layout throughput table;
+    an unfittable layout is skipped, not fatal."""
+    serve_bench = _import_serve_bench()
+    out = tmp_path / "mesh.json"
+    rc = serve_bench.main(
+        [
+            "--quick",
+            "--mesh-layouts", "single", "tp2", "tp16",
+            "--single-duration", "0.2",
+            "--json", str(out),
+        ]
+    )
+    assert rc == 0
+    report = json.loads(out.read_text())
+    rows = report["mesh_layouts"]
+    assert [r["layout"] for r in rows] == ["single", "dp4-tp2"]
+    assert all(r["parity_ok"] for r in rows)
+    assert all(r["single_rps"] > 0 and "rps_per_replica" in r for r in rows)
