@@ -1,0 +1,21 @@
+# Convenience targets; CI runs the same commands (see pytest.ini for the
+# tier-1 gate and docs/ANALYSIS.md for the analysis suite).
+
+PY ?= python
+export JAX_PLATFORMS ?= cpu
+
+.PHONY: test test-all analyze analyze-full
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-all:
+	$(PY) -m pytest tests/ -q -m ""
+
+# Static analysis + config sweep over the package; nonzero exit on any
+# non-baselined finding or stale baseline entry.
+analyze:
+	$(PY) scripts/analyze.py --quick
+
+analyze-full:
+	$(PY) scripts/analyze.py

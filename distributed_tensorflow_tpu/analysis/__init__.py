@@ -1,0 +1,33 @@
+"""graftcheck: project-native static analysis for the training/serving stack.
+
+Checkers:
+
+* :mod:`.jaxlint`   — JAX correctness pitfalls (JL001–JL004)
+* :mod:`.locklint`  — static concurrency rules (LL001–LL003)
+* :mod:`.shardcheck`— mesh-axis and serving-layout validation (SC001–SC002)
+
+plus the runtime lock-order sanitizer in
+:mod:`distributed_tensorflow_tpu.obs.sanitizer`. Run everything via
+``scripts/analyze.py``; see ``docs/ANALYSIS.md`` for the check catalog and
+baseline workflow.
+"""
+
+from .findings import (
+    Baseline,
+    BaselineResult,
+    Finding,
+    SourceFile,
+    apply_baseline,
+    iter_sources,
+    load_baseline,
+)
+
+__all__ = [
+    "Baseline",
+    "BaselineResult",
+    "Finding",
+    "SourceFile",
+    "apply_baseline",
+    "iter_sources",
+    "load_baseline",
+]

@@ -1,0 +1,519 @@
+"""jaxlint: AST checks for JAX correctness pitfalls.
+
+Rules
+-----
+JL001  PRNG key reuse — the same key variable fed to two consuming
+       ``jax.random.*`` calls without an intervening split/fold_in, or
+       consumed inside a loop without per-iteration derivation.
+JL002  Host-side effect inside a traced function — ``print``/``time.*``/
+       ``input``/``open``/``breakpoint`` calls, or mutation of closed-over
+       state (``global``/``nonlocal`` writes, ``.append`` etc. on
+       non-local names), in any function that is jitted/shard_mapped or
+       used as a ``lax.scan``/``grad`` body in the same module.
+JL003  Blocking transfer (``jax.device_get``, ``.block_until_ready()``,
+       ``np.asarray`` on a traced value) inside a designated hot-path
+       module — these modules pipeline dispatch and must only block at
+       their one designated fetch point.
+JL004  Python ``if``/``while`` on a tracer-derived value inside a traced
+       function. Shape/dtype/structure inspection (``.shape``, ``len``,
+       ``isinstance``, ``is None``) launders the taint — those branches
+       are resolved at trace time and are fine.
+
+Detection of "traced function" is module-local and name-based: functions
+passed (by name) to ``jax.jit``/``shard_map``/``pmap``/``grad``/
+``value_and_grad``/``lax.scan``/``lax.while_loop``/``lax.fori_loop``/
+``checkpoint``, or decorated with jit/shard_map/partial(jit, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .findings import Finding, ScopeIndex, SourceFile, dotted_name
+
+__all__ = ["run", "CHECKS", "HOT_MODULES"]
+
+CHECKS = ("JL001", "JL002", "JL003", "JL004")
+
+# Modules whose steady-state loop must never block on device transfers
+# except at their designated fetch point (baselined explicitly).
+HOT_MODULES = (
+    "train/loop.py",
+    "serve/engine.py",
+    "serve/batcher.py",
+    "data/prefetch.py",
+)
+
+# jax.random.* functions that DERIVE keys rather than consume randomness.
+_KEY_DERIVERS = {
+    "key",
+    "PRNGKey",
+    "split",
+    "fold_in",
+    "clone",
+    "key_data",
+    "wrap_key_data",
+    "key_impl",
+}
+
+# Callables that trace their function argument(s).
+_TRACING_CALLS = {
+    "jax.jit",
+    "jit",
+    "jax.pmap",
+    "pmap",
+    "jax.shard_map",
+    "shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.vmap",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "lax.scan",
+    "jax.lax.while_loop",
+    "lax.while_loop",
+    "jax.lax.fori_loop",
+    "lax.fori_loop",
+    "jax.lax.cond",
+    "lax.cond",
+    "jax.eval_shape",
+}
+
+_JIT_DECORATORS = {"jit", "jax.jit", "pmap", "jax.pmap", "shard_map", "jax.shard_map"}
+
+_HOST_EFFECT_CALLS = {
+    "print",
+    "input",
+    "breakpoint",
+    "open",
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.sleep",
+    "time.process_time",
+}
+
+_MUTATING_METHODS = {"append", "extend", "add", "update", "insert", "setdefault", "pop"}
+
+# Attribute/call forms that convert a tracer into a static Python value.
+_LAUNDER_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding", "itemsize"}
+_LAUNDER_CALLS = {"len", "isinstance", "type", "getattr", "hasattr", "id", "repr", "str"}
+
+
+def run(sources: Iterable[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        scopes = ScopeIndex(src.tree)
+        findings.extend(_check_key_reuse(src, scopes))
+        traced = _traced_functions(src.tree)
+        for fn in traced:
+            findings.extend(_check_host_effects(src, scopes, fn))
+            findings.extend(_check_tracer_branch(src, scopes, fn))
+        if any(src.rel.endswith(m) for m in HOT_MODULES):
+            findings.extend(_check_blocking_transfers(src, scopes))
+    return findings
+
+
+# ---------------------------------------------------------------- JL001
+
+
+def _is_key_deriver(name: str) -> bool:
+    return name.rsplit(".", 1)[-1] in _KEY_DERIVERS
+
+
+def _check_key_reuse(src: SourceFile, scopes: ScopeIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in _all_functions(src.tree):
+        findings.extend(_key_reuse_in_function(src, scopes, fn))
+    return findings
+
+
+def _key_reuse_in_function(
+    src: SourceFile, scopes: ScopeIndex, fn: ast.FunctionDef | ast.AsyncFunctionDef
+) -> list[Finding]:
+    # Event stream: (line, col, kind, name). kind in {assign, consume}.
+    events: list[tuple[int, int, str, str]] = []
+    key_names: set[str] = set()
+    loops: list[ast.For | ast.While] = []
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.While)) and node is not fn:
+            loops.append(node)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func) or ""
+            if ("random" in callee and _is_key_deriver(callee)) or callee.endswith(
+                "make_rng"
+            ):
+                for tgt in node.targets:
+                    for name in _target_names(tgt):
+                        key_names.add(name)
+                        events.append((node.lineno, node.col_offset, "assign", name))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for name in _target_names(tgt):
+                    events.append((node.lineno, node.col_offset, "assign", name))
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if (
+                callee.startswith(("jax.random.", "random.", "jrandom.", "jr."))
+                and not _is_key_deriver(callee)
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                events.append(
+                    (node.lineno, node.col_offset, "consume", node.args[0].id)
+                )
+
+    events.sort(key=lambda e: (e[0], e[1]))
+    consumed_at: dict[str, int] = {}
+    findings: list[Finding] = []
+    for line, _col, kind, name in events:
+        if kind == "assign":
+            consumed_at.pop(name, None)
+        elif name in key_names:
+            if name in consumed_at:
+                findings.append(
+                    Finding(
+                        check="JL001",
+                        path=src.rel,
+                        line=line,
+                        scope=scopes.lookup(line),
+                        message=(
+                            f"PRNG key '{name}' already consumed at line "
+                            f"{consumed_at[name]}; split or fold_in before reuse"
+                        ),
+                    )
+                )
+            consumed_at[name] = line
+
+    # Loop-carried reuse: key consumed inside a loop body but never
+    # re-derived inside that body — every iteration samples identically.
+    for loop in loops:
+        assigned: set[str] = set()
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    assigned.update(_target_names(tgt))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                assigned.update(_target_names(node.target))
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func) or ""
+                if (
+                    callee.startswith(("jax.random.", "jrandom.", "jr."))
+                    and not _is_key_deriver(callee)
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                ):
+                    name = node.args[0].id
+                    if name in key_names and name not in assigned:
+                        findings.append(
+                            Finding(
+                                check="JL001",
+                                path=src.rel,
+                                line=node.lineno,
+                                scope=scopes.lookup(node.lineno),
+                                message=(
+                                    f"PRNG key '{name}' consumed in a loop without "
+                                    "per-iteration split/fold_in"
+                                ),
+                            )
+                        )
+    return findings
+
+
+# ---------------------------------------------------------------- traced-fn set
+
+
+def _all_functions(tree: ast.AST) -> list[ast.FunctionDef | ast.AsyncFunctionDef]:
+    return [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def _traced_functions(tree: ast.Module) -> list[ast.FunctionDef | ast.AsyncFunctionDef]:
+    traced_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if callee in _TRACING_CALLS:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        traced_names.add(arg.id)
+
+    out = []
+    for fn in _all_functions(tree):
+        if fn.name in traced_names:
+            out.append(fn)
+            continue
+        for dec in fn.decorator_list:
+            d = dec
+            if isinstance(d, ast.Call):  # @partial(jit, ...) / @jit(...)
+                inner = dotted_name(d.func) or ""
+                if inner in _JIT_DECORATORS:
+                    out.append(fn)
+                    break
+                if inner in {"partial", "functools.partial"} and d.args:
+                    first = dotted_name(d.args[0]) or ""
+                    if first in _JIT_DECORATORS:
+                        out.append(fn)
+                        break
+            elif (dotted_name(d) or "") in _JIT_DECORATORS:
+                out.append(fn)
+                break
+    return out
+
+
+# ---------------------------------------------------------------- JL002
+
+
+def _check_host_effects(
+    src: SourceFile, scopes: ScopeIndex, fn: ast.FunctionDef | ast.AsyncFunctionDef
+) -> list[Finding]:
+    findings: list[Finding] = []
+    local_names = _local_names(fn)
+    global_writes: set[str] = set()
+    # Mutation-style calls only count when the result is discarded — a
+    # statement-level `seen.append(x)` mutates; `new, st = tx.update(...)`
+    # is a pure functional API that happens to be named "update".
+    stmt_calls = {
+        id(node.value)
+        for node in ast.walk(fn)
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+    }
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            global_writes.update(node.names)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if callee in _HOST_EFFECT_CALLS:
+                findings.append(
+                    Finding(
+                        check="JL002",
+                        path=src.rel,
+                        line=node.lineno,
+                        scope=scopes.lookup(node.lineno),
+                        message=(
+                            f"host-side effect '{callee}()' inside traced "
+                            f"function '{fn.name}' runs at trace time only"
+                        ),
+                    )
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_METHODS
+                and id(node) in stmt_calls
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id not in local_names
+            ):
+                findings.append(
+                    Finding(
+                        check="JL002",
+                        path=src.rel,
+                        line=node.lineno,
+                        scope=scopes.lookup(node.lineno),
+                        message=(
+                            f"mutation of closed-over '{node.func.value.id}."
+                            f"{node.func.attr}()' inside traced function "
+                            f"'{fn.name}' happens once at trace time"
+                        ),
+                    )
+                )
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for tgt in targets:
+                for name in _target_names(tgt):
+                    if name in global_writes:
+                        findings.append(
+                            Finding(
+                                check="JL002",
+                                path=src.rel,
+                                line=node.lineno,
+                                scope=scopes.lookup(node.lineno),
+                                message=(
+                                    f"write to global/nonlocal '{name}' inside "
+                                    f"traced function '{fn.name}'"
+                                ),
+                            )
+                        )
+    return findings
+
+
+# ---------------------------------------------------------------- JL003
+
+
+_BLOCKING_TRANSFER_CALLS = {"jax.device_get", "jax.block_until_ready"}
+
+
+def _check_blocking_transfers(src: SourceFile, scopes: ScopeIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        hit = None
+        if callee in _BLOCKING_TRANSFER_CALLS:
+            hit = f"{callee}()"
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "block_until_ready":
+            hit = ".block_until_ready()"
+        elif callee in {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}:
+            # Only flag when the argument *names* a device-side value —
+            # np.asarray on request payloads (lists/JSON) is host-only and
+            # exactly what the assemble phase is for.
+            if node.args and _looks_device_side(node.args[0]):
+                hit = f"{callee}() (implicit device→host copy)"
+        if hit:
+            findings.append(
+                Finding(
+                    check="JL003",
+                    path=src.rel,
+                    line=node.lineno,
+                    scope=scopes.lookup(node.lineno),
+                    message=(
+                        f"blocking transfer {hit} in hot-path module; only the "
+                        "designated fetch point may block"
+                    ),
+                )
+            )
+    return findings
+
+
+_DEVICE_NAME_HINTS = ("device", "dev_", "_dev", "out_ref", "in_flight", "on_chip")
+
+
+def _looks_device_side(arg: ast.expr) -> bool:
+    name = dotted_name(arg) or ""
+    low = name.lower()
+    return any(h in low for h in _DEVICE_NAME_HINTS)
+
+
+# ---------------------------------------------------------------- JL004
+
+
+def _check_tracer_branch(
+    src: SourceFile, scopes: ScopeIndex, fn: ast.FunctionDef | ast.AsyncFunctionDef
+) -> list[Finding]:
+    tainted: set[str] = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    if fn.args.vararg:
+        tainted.add(fn.args.vararg.arg)
+    tainted.discard("self")
+
+    # One forward propagation pass in source order (good enough for the
+    # straight-line style of traced step functions).
+    for node in sorted(
+        (n for n in ast.walk(fn) if isinstance(n, ast.Assign)),
+        key=lambda n: (n.lineno, n.col_offset),
+    ):
+        rhs_tainted = _expr_tainted(node.value, tainted)
+        for tgt in node.targets:
+            for name in _target_names(tgt):
+                if rhs_tainted:
+                    tainted.add(name)
+                else:
+                    tainted.discard(name)
+
+    findings: list[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        if _test_is_static(node.test):
+            continue
+        if _expr_tainted(node.test, tainted):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            findings.append(
+                Finding(
+                    check="JL004",
+                    path=src.rel,
+                    line=node.lineno,
+                    scope=scopes.lookup(node.lineno),
+                    message=(
+                        f"Python '{kind}' on a tracer-derived value inside traced "
+                        f"function '{fn.name}'; use lax.cond/jnp.where"
+                    ),
+                )
+            )
+    return findings
+
+
+def _test_is_static(test: ast.expr) -> bool:
+    """`is None` / isinstance / len / shape comparisons resolve at trace time."""
+    if isinstance(test, ast.Compare) and any(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return True
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if callee in _LAUNDER_CALLS:
+                return True
+        if isinstance(node, ast.Attribute) and node.attr in _LAUNDER_ATTRS:
+            return True
+    return False
+
+
+def _expr_tainted(expr: ast.expr, tainted: set[str]) -> bool:
+    if _contains_launder(expr):
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return True
+    return False
+
+
+def _contains_launder(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _LAUNDER_ATTRS:
+            return True
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if callee in _LAUNDER_CALLS:
+                return True
+    return False
+
+
+# ---------------------------------------------------------------- shared helpers
+
+
+def _target_names(tgt: ast.expr) -> list[str]:
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in tgt.elts:
+            out.extend(_target_names(elt))
+        return out
+    if isinstance(tgt, ast.Starred):
+        return _target_names(tgt.value)
+    return []
+
+
+def _local_names(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    names = {a.arg for a in fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs}
+    if fn.args.vararg:
+        names.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        names.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                names.update(_target_names(tgt))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            names.update(_target_names(node.target))
+        elif isinstance(node, (ast.For,)):
+            names.update(_target_names(node.target))
+        elif isinstance(node, ast.comprehension):
+            names.update(_target_names(node.target))
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    names.update(_target_names(item.optional_vars))
+    return names

@@ -1,0 +1,253 @@
+"""shardcheck: mesh-axis and serving-layout validation before any device exists.
+
+Two halves:
+
+* **Static (SC001)** — every literal axis name appearing in a
+  ``PartitionSpec``/``P(...)`` constructor, a ``lax`` collective
+  (``psum``/``pmean``/``all_to_all``/...), or a ``mesh.shape[...]`` /
+  ``mesh.shape.get(...)`` lookup must be declared in ``AXIS_ORDER`` in
+  ``parallel/mesh.py``. The axis vocabulary is read from the *analyzed
+  tree's own* ``parallel/mesh.py`` AST, so this pass needs no imports and
+  follows the code under analysis, not the installed package.
+
+* **Config sweep (SC002, full mode only)** — re-run the tp/ep/pp
+  divisibility arithmetic that ``serve/engine.py::_serve_config`` enforces
+  at runtime, over the default CLI serving configs (every BERT preset from
+  ``cli/train.py``) crossed with the mesh layouts exercised by
+  ``tests/test_serve_mesh.py``, on the 8-device test topology. Each
+  (preset, layout) cell must resolve to one of three *designed* outcomes:
+  ``serves``, ``falls_back`` (plan_serve_mesh warn-not-crash), or
+  ``rejects`` (clean ValueError at startup). Anything else — an unexpected
+  exception type, or a layout the planner accepts but the engine then dies
+  on — is a finding: it would surface as a raw XLA error on real hardware.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable
+
+from .findings import Finding, ScopeIndex, SourceFile, dotted_name
+
+__all__ = ["run", "run_config_sweep", "CHECKS", "declared_axes", "DEFAULT_LAYOUTS"]
+
+CHECKS = ("SC001", "SC002")
+
+_SPEC_CTORS = {"P", "PartitionSpec", "jax.sharding.PartitionSpec"}
+_COLLECTIVES = {
+    "lax.psum",
+    "lax.pmean",
+    "lax.pmax",
+    "lax.pmin",
+    "lax.axis_index",
+    "lax.all_gather",
+    "lax.all_to_all",
+    "lax.ppermute",
+    "lax.psum_scatter",
+    "jax.lax.psum",
+    "jax.lax.pmean",
+    "jax.lax.pmax",
+    "jax.lax.pmin",
+    "jax.lax.axis_index",
+    "jax.lax.all_gather",
+    "jax.lax.all_to_all",
+    "jax.lax.ppermute",
+    "jax.lax.psum_scatter",
+}
+
+# Mesh layouts exercised by tests/test_serve_mesh.py plus the CLI default
+# and the documented fallback probes, as (tp, pp, ep) on 8 devices.
+DEFAULT_LAYOUTS: tuple[tuple[int, int, int], ...] = (
+    (1, 1, 1),  # cli/serve.py defaults (dp over all chips)
+    (2, 1, 1),
+    (4, 1, 1),  # test_serve_mesh TP parity layout
+    (1, 2, 1),  # PP layout (dp4-pp2)
+    (1, 1, 4),  # EP layout (dp2-ep4)
+    (2, 2, 2),  # combined tp2-pp2-ep2
+    (16, 1, 1),  # oversized: must fall back, never crash
+    (3, 1, 1),  # non-dividing: must fall back, never crash
+)
+
+
+def declared_axes(sources: Iterable[SourceFile]) -> set[str]:
+    """Extract AXIS_ORDER from the analyzed tree's parallel/mesh.py."""
+    for src in sources:
+        if not src.rel.endswith("parallel/mesh.py"):
+            continue
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "AXIS_ORDER"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                return {
+                    elt.value
+                    for elt in node.value.elts
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+                }
+    return set()
+
+
+def run(sources: Iterable[SourceFile]) -> list[Finding]:
+    sources = list(sources)
+    axes = declared_axes(sources)
+    if not axes:
+        return []  # nothing to validate against (fixture trees without mesh.py)
+    findings: list[Finding] = []
+    for src in sources:
+        scopes = ScopeIndex(src.tree)
+        for node in ast.walk(src.tree):
+            for line, name in _literal_axis_uses(node):
+                if name not in axes:
+                    findings.append(
+                        Finding(
+                            check="SC001",
+                            path=src.rel,
+                            line=line,
+                            scope=scopes.lookup(line),
+                            message=(
+                                f"axis name '{name}' is not declared in "
+                                f"parallel/mesh.py AXIS_ORDER {sorted(axes)}"
+                            ),
+                        )
+                    )
+    return findings
+
+
+def _literal_axis_uses(node: ast.AST) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func) or ""
+        if callee in _SPEC_CTORS:
+            for arg in node.args:
+                out.extend(_axis_literals(arg))
+        elif callee in _COLLECTIVES and len(node.args) >= 2:
+            out.extend(_axis_literals(node.args[1]))
+        elif callee in _COLLECTIVES:
+            for kw in node.keywords:
+                if kw.arg in {"axis_name", "axis"}:
+                    out.extend(_axis_literals(kw.value))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and (dotted_name(node.func.value) or "").endswith(".shape")
+            and node.args
+        ):
+            out.extend(_axis_literals(node.args[0]))
+    elif (
+        isinstance(node, ast.Subscript)
+        and (dotted_name(node.value) or "").endswith(".shape")
+    ):
+        out.extend(_axis_literals(node.slice))
+    return out
+
+
+def _axis_literals(expr: ast.expr) -> list[tuple[int, str]]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [(expr.lineno, expr.value)]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: list[tuple[int, str]] = []
+        for elt in expr.elts:
+            out.extend(_axis_literals(elt))
+        return out
+    return []
+
+
+# ---------------------------------------------------------------- SC002
+
+
+def run_config_sweep(
+    n_devices: int = 8,
+    layouts: Iterable[tuple[int, int, int]] = DEFAULT_LAYOUTS,
+) -> tuple[list[Finding], list[dict]]:
+    """Cross BERT presets with serving layouts; classify every cell.
+
+    Returns ``(findings, matrix)`` where matrix rows record the designed
+    outcome per cell (for the JSON report). Imports the package lazily —
+    this is the only part of shardcheck that needs jax importable.
+    """
+    from ..cli.train import PRESETS
+    from ..models.bert import BertConfig
+    from ..serve.engine import BertInferenceEngine, plan_serve_mesh
+
+    findings: list[Finding] = []
+    matrix: list[dict] = []
+    presets = {
+        name: wl for name, wl in PRESETS.items() if "bert" in name.lower()
+    }
+    for name, wl in presets.items():
+        # Mirror cli/serve.py config reconstruction: BertConfig defaults with
+        # the preset's geometry overrides. max_position/dtype don't affect
+        # the divisibility arithmetic under test.
+        overrides: dict = {}
+        if wl.bert_layers:
+            overrides["num_layers"] = wl.bert_layers
+        if wl.bert_hidden:
+            overrides.update(
+                hidden_size=wl.bert_hidden, intermediate_size=4 * wl.bert_hidden
+            )
+        if wl.bert_vocab:
+            overrides["vocab_size"] = wl.bert_vocab
+        if getattr(wl, "moe_experts", 0):
+            overrides["moe_experts"] = wl.moe_experts
+        base_cfg = BertConfig(**overrides)
+
+        for tp, pp, ep in layouts:
+            cell = {"preset": name, "tp": tp, "pp": pp, "ep": ep}
+            try:
+                spec, fell_back = plan_serve_mesh(
+                    tp=tp, pp=pp, ep=ep, n_devices=n_devices
+                )
+            except Exception as exc:  # planner must never raise
+                findings.append(
+                    Finding(
+                        check="SC002",
+                        path="distributed_tensorflow_tpu/serve/engine.py",
+                        line=0,
+                        scope="plan_serve_mesh",
+                        message=(
+                            f"planner raised {type(exc).__name__} for layout "
+                            f"tp={tp} pp={pp} ep={ep} on {n_devices} devices "
+                            f"(must warn and fall back): {exc}"
+                        ),
+                    )
+                )
+                cell["outcome"] = f"planner-raised:{type(exc).__name__}"
+                matrix.append(cell)
+                continue
+            if fell_back:
+                cell["outcome"] = "falls_back"
+                matrix.append(cell)
+                continue
+            cfg = base_cfg
+            if pp > 1:
+                # cli/serve.py sets pipeline_parallel from --pp at load time.
+                cfg = BertConfig(**{**overrides, "pipeline_parallel": pp})
+            try:
+                BertInferenceEngine._serve_config(cfg, tp=tp, ep=ep, pp=pp)
+                cell["outcome"] = "serves"
+            except ValueError as exc:
+                # Designed loud rejection (clean startup error, no XLA trace).
+                cell["outcome"] = "rejects"
+                cell["reason"] = str(exc)
+            except Exception as exc:
+                findings.append(
+                    Finding(
+                        check="SC002",
+                        path="distributed_tensorflow_tpu/serve/engine.py",
+                        line=0,
+                        scope="BertInferenceEngine._serve_config",
+                        message=(
+                            f"layout tp={tp} pp={pp} ep={ep} on preset '{name}' "
+                            f"raised {type(exc).__name__} instead of a clean "
+                            f"ValueError: {exc}"
+                        ),
+                    )
+                )
+                cell["outcome"] = f"raised:{type(exc).__name__}"
+            matrix.append(cell)
+    return findings, matrix

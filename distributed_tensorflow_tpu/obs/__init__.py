@@ -24,6 +24,10 @@ from distributed_tensorflow_tpu.obs.profile import (  # noqa: F401
     profile_window,
     trace_steps,
 )
+from distributed_tensorflow_tpu.obs.sanitizer import (  # noqa: F401
+    LockOrderSanitizer,
+    sanitize_locks,
+)
 from distributed_tensorflow_tpu.obs.trace import (  # noqa: F401
     NULL_TRACER,
     Span,

@@ -63,7 +63,13 @@ class _StepWindow:
         if self._done or self._active:
             return
         _PROFILER_LOCK.acquire()
-        jax.profiler.start_trace(self._logdir)
+        try:
+            jax.profiler.start_trace(self._logdir)
+        except BaseException:
+            # A failed start (bad logdir, profiler already active elsewhere)
+            # must not leave the module lock held forever.
+            _PROFILER_LOCK.release()
+            raise
         self._active = True
 
     def after_step(self, out=None) -> None:
@@ -77,10 +83,14 @@ class _StepWindow:
 
     def close(self) -> None:
         if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
-            _PROFILER_LOCK.release()
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                # Even if stop_trace dies the window is over: release the
+                # module lock so later windows/profilez can still run.
+                self._active = False
+                self._done = True
+                _PROFILER_LOCK.release()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,198 @@
+"""locktrace: opt-in runtime lock-order sanitizer.
+
+``sanitize_locks()`` monkeypatches ``threading.Lock`` and
+``threading.Condition`` so every lock created inside the context is a
+``TrackedLock`` that records, per acquisition, which locks the acquiring
+thread already held. Those (held → acquired) edges form a directed
+acquisition-order graph; a cycle in it means two threads can take the same
+locks in opposite orders — a potential deadlock, reported even if the
+interleaving never actually deadlocked during the test run.
+
+Nodes are *creation sites* (``file:lineno`` of the ``Lock()`` call), not
+instances, so the pattern generalizes across pool/queue instances created
+from the same line. Self-edges (site → same site) are ignored: nested
+acquisition of two instances from one constructor line (e.g. two queues)
+is ordered by the caller, not by this graph.
+
+Only locks constructed *while the patch is installed* are tracked —
+pre-existing module locks and stdlib internals (logging, importlib) keep
+their native types, so the sanitizer cannot perturb code outside the
+system under test. ``queue.Queue`` and ``threading.Event`` objects built
+inside the window *are* tracked (their internal mutex/Condition route
+through the patched constructors), which is exactly what the batcher /
+prefetch soak tests want.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from contextlib import contextmanager
+
+__all__ = ["LockOrderSanitizer", "sanitize_locks"]
+
+_REAL_LOCK = threading.Lock
+_REAL_CONDITION = threading.Condition
+
+
+def _creation_site(skip_prefixes: tuple[str, ...]) -> str:
+    """file:lineno of the frame that called Lock()/Condition()."""
+    for frame in reversed(traceback.extract_stack(limit=12)[:-2]):
+        fname = frame.filename
+        if any(p in fname for p in skip_prefixes):
+            continue
+        return f"{fname.rsplit('/', 1)[-1]}:{frame.lineno}"
+    return "<unknown>"
+
+
+class LockOrderSanitizer:
+    """Acquisition graph + cycle detection over tracked locks."""
+
+    def __init__(self) -> None:
+        self._graph_lock = _REAL_LOCK()
+        # site -> set of sites acquired while holding it, with one example
+        # stack edge label for the report.
+        self._edges: dict[str, set[str]] = {}
+        self._held = threading.local()
+        self.acquisitions = 0
+
+    # -- called by TrackedLock ------------------------------------------
+
+    def _stack(self) -> list[str]:
+        if not hasattr(self._held, "stack"):
+            self._held.stack = []
+        return self._held.stack
+
+    def note_acquired(self, site: str) -> None:
+        stack = self._stack()
+        if stack:
+            holder = stack[-1]
+            if holder != site:
+                with self._graph_lock:
+                    self._edges.setdefault(holder, set()).add(site)
+        with self._graph_lock:
+            self.acquisitions += 1
+        stack.append(site)
+
+    def note_released(self, site: str) -> None:
+        stack = self._stack()
+        # Locks may be released out of LIFO order (Condition.wait releases
+        # the underlying lock mid-stack); remove the most recent entry.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == site:
+                del stack[i]
+                return
+
+    # -- reporting ------------------------------------------------------
+
+    def edges(self) -> dict[str, set[str]]:
+        with self._graph_lock:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    def cycles(self) -> list[list[str]]:
+        """All elementary acquisition-order cycles (DFS, deduplicated)."""
+        graph = self.edges()
+        cycles: list[list[str]] = []
+        seen: set[tuple[str, ...]] = set()
+
+        def dfs(node: str, path: list[str], on_path: set[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    # Canonicalize rotation so each cycle reports once.
+                    core = cyc[:-1]
+                    k = core.index(min(core))
+                    canon = tuple(core[k:] + core[:k])
+                    if canon not in seen:
+                        seen.add(canon)
+                        cycles.append(list(canon) + [canon[0]])
+                elif nxt not in path:
+                    dfs(nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(graph):
+            dfs(start, [start], {start})
+        return cycles
+
+    def report(self) -> str:
+        lines = [f"lock-order sanitizer: {self.acquisitions} acquisitions"]
+        for src in sorted(self._edges):
+            for dst in sorted(self._edges[src]):
+                lines.append(f"  {src} -> {dst}")
+        cycles = self.cycles()
+        if cycles:
+            lines.append("POTENTIAL DEADLOCK CYCLES:")
+            for cyc in cycles:
+                lines.append("  " + " -> ".join(cyc))
+        else:
+            lines.append("no acquisition-order cycles")
+        return "\n".join(lines)
+
+    def assert_no_cycles(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            raise AssertionError(
+                "lock acquisition-order cycle(s) detected:\n" + self.report()
+            )
+
+
+class TrackedLock:
+    """Drop-in ``threading.Lock`` recording acquisition order."""
+
+    def __init__(self, sanitizer: LockOrderSanitizer, site: str) -> None:
+        self._lock = _REAL_LOCK()
+        self._san = sanitizer
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._san.note_acquired(self._site)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        self._san.note_released(self._site)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<TrackedLock {self._site} {self._lock!r}>"
+
+
+@contextmanager
+def sanitize_locks(
+    skip_prefixes: tuple[str, ...] = ("threading.py", "sanitizer.py", "queue.py")
+):
+    """Context manager: track all locks created inside; yields the sanitizer.
+
+    ``threading.Condition`` keeps its stdlib implementation but, created
+    with no argument, now wraps a ``TrackedLock`` — the stdlib Condition
+    handles foreign locks via its documented ``acquire(0)``/default
+    ``_release_save`` fallbacks, so ``with cv:`` and ``cv.wait()`` record
+    acquire/release events like any other tracked lock. Waiter locks are
+    ``_thread.allocate_lock`` internals and stay untracked.
+    """
+    san = LockOrderSanitizer()
+
+    def make_lock() -> TrackedLock:
+        return TrackedLock(san, _creation_site(skip_prefixes))
+
+    def make_condition(lock=None):
+        if lock is None:
+            lock = make_lock()
+        return _REAL_CONDITION(lock)
+
+    threading.Lock = make_lock  # type: ignore[assignment]
+    threading.Condition = make_condition  # type: ignore[assignment]
+    try:
+        yield san
+    finally:
+        threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+        threading.Condition = _REAL_CONDITION  # type: ignore[assignment]

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Run the graftcheck analysis suite over the package.
+
+Usage::
+
+    python scripts/analyze.py [--root DIR] [--format text|json]
+                              [--quick] [--baseline FILE] [--no-baseline]
+
+Exit status is nonzero when any non-baselined finding is active, or when a
+baseline suppression has gone stale (matches nothing) for a checker that
+ran. ``--quick`` skips the SC002 serving-config sweep (the only stage that
+imports the package); the AST checkers always run over every module.
+
+See docs/ANALYSIS.md for the check catalog and baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument(
+        "--root", type=Path, default=REPO_ROOT,
+        help="repo root containing the package to analyze",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--quick", action="store_true",
+        help="skip the SC002 config sweep (no package import needed)",
+    )
+    ap.add_argument(
+        "--baseline", type=Path, default=None,
+        help="baseline file (default: <root>/<package>/analysis/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline file (report every finding as active)",
+    )
+    ap.add_argument(
+        "--package", default="distributed_tensorflow_tpu",
+        help="package directory name under --root",
+    )
+    args = ap.parse_args(argv)
+
+    from distributed_tensorflow_tpu.analysis import findings as fmod
+    from distributed_tensorflow_tpu.analysis import jaxlint, locklint, shardcheck
+
+    t0 = time.monotonic()
+    sources = fmod.iter_sources(args.root, package=args.package)
+
+    all_findings: list[fmod.Finding] = []
+    checks_run: list[str] = []
+
+    all_findings.extend(jaxlint.run(sources))
+    checks_run.extend(jaxlint.CHECKS)
+    all_findings.extend(locklint.run(sources))
+    checks_run.extend(locklint.CHECKS)
+    all_findings.extend(shardcheck.run(sources))
+    checks_run.append("SC001")
+
+    matrix: list[dict] = []
+    if not args.quick:
+        sweep_findings, matrix = shardcheck.run_config_sweep()
+        all_findings.extend(sweep_findings)
+        checks_run.append("SC002")
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = args.root / args.package / "analysis" / "baseline.json"
+    baseline = (
+        fmod.Baseline(entries={})
+        if args.no_baseline
+        else fmod.load_baseline(baseline_path)
+    )
+    result = fmod.apply_baseline(all_findings, baseline, checks_run)
+    elapsed = time.monotonic() - t0
+
+    ok = not result.active and not result.stale
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "ok": ok,
+                    "elapsed_s": round(elapsed, 2),
+                    "files": len(sources),
+                    "checks_run": checks_run,
+                    "active": [vars(f) for f in result.active],
+                    "suppressed": [
+                        {**vars(f), "reason": baseline.entries.get(f.suppress_id, "")}
+                        for f in result.suppressed
+                    ],
+                    "stale_baseline": result.stale,
+                    "layout_matrix": matrix,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in result.active:
+            print(f.render())
+        for f in result.suppressed:
+            reason = baseline.entries.get(f.suppress_id, "")
+            print(f"baselined: {f.render()}  # {reason}")
+        for sid in result.stale:
+            print(f"STALE baseline entry (matches nothing): {sid}")
+        if matrix:
+            outcomes = {}
+            for cell in matrix:
+                outcomes[cell["outcome"]] = outcomes.get(cell["outcome"], 0) + 1
+            print(
+                "layout sweep: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(outcomes.items()))
+            )
+        print(
+            f"graftcheck: {len(sources)} files, {len(result.active)} active, "
+            f"{len(result.suppressed)} baselined, {len(result.stale)} stale "
+            f"({elapsed:.1f}s) -> {'OK' if ok else 'FAIL'}"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

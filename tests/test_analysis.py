@@ -1,0 +1,567 @@
+"""graftcheck suite tests (analysis/ + obs/sanitizer.py + scripts/analyze.py).
+
+Three layers:
+
+* per-rule fixture snippets — every rule has a minimal violating snippet it
+  must flag and a conforming snippet it must pass (the conforming ones are
+  modeled on real idioms from this repo that earlier checker drafts
+  false-positived on: dict ``.get`` under a lock, ``Condition.wait`` on the
+  held condition, optax's pure ``tx.update``, shape-laundered branches);
+* the runtime lock-order sanitizer — deterministic cycle seeding plus
+  stdlib Condition/queue compatibility;
+* the runner — the real package must analyze clean against the checked-in
+  baseline in quick mode under the 30 s budget, a seeded violation must
+  exit nonzero, and the SC002 layout sweep must prove the PR-7 fallback
+  layouts warn-not-crash.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from distributed_tensorflow_tpu.analysis import (
+    Baseline,
+    SourceFile,
+    apply_baseline,
+)
+from distributed_tensorflow_tpu.analysis import jaxlint, locklint, shardcheck
+from distributed_tensorflow_tpu.obs.sanitizer import sanitize_locks
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _src(rel: str, code: str) -> SourceFile:
+    code = textwrap.dedent(code)
+    return SourceFile(
+        path=Path("/fixture") / rel, rel=rel, text=code, tree=ast.parse(code)
+    )
+
+
+def _checks(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+# ------------------------------------------------------------------ jaxlint
+
+
+def test_jl001_flags_key_reuse_and_passes_split():
+    bad = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def f():
+            k = jax.random.key(0)
+            a = jax.random.normal(k, (2,))
+            b = jax.random.uniform(k, (2,))
+            return a + b
+        """,
+    )
+    good = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def g():
+            k = jax.random.key(0)
+            k1, k2 = jax.random.split(k)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            return a + b
+        """,
+    )
+    assert _checks(jaxlint.run([bad]), "JL001")
+    assert not _checks(jaxlint.run([good]), "JL001")
+
+
+def test_jl001_flags_loop_carried_reuse_and_passes_fold_in():
+    bad = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def h():
+            k = jax.random.key(0)
+            out = []
+            for i in range(3):
+                out.append(jax.random.normal(k, (2,)))
+            return out
+        """,
+    )
+    good = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def h2():
+            k = jax.random.key(0)
+            out = []
+            for i in range(3):
+                ki = jax.random.fold_in(k, i)
+                out.append(jax.random.normal(ki, (2,)))
+            return out
+        """,
+    )
+    assert _checks(jaxlint.run([bad]), "JL001")
+    assert not _checks(jaxlint.run([good]), "JL001")
+
+
+def test_jl002_flags_host_effects_in_traced_fn_only():
+    bad = _src(
+        "pkg/mod.py",
+        """
+        import jax, time
+
+        seen = []
+
+        def step(x):
+            print("tracing", x)
+            t = time.time()
+            seen.append(t)
+            return x * 2
+
+        fast = jax.jit(step)
+        """,
+    )
+    good = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def step(x):
+            y = x * 2
+            return y
+
+        def untraced_logger(x):
+            print("fine here", x)
+
+        fast = jax.jit(step)
+        """,
+    )
+    msgs = [f.message for f in _checks(jaxlint.run([bad]), "JL002")]
+    assert any("print" in m for m in msgs)
+    assert any("time.time" in m for m in msgs)
+    assert any("seen.append" in m for m in msgs)
+    assert not jaxlint.run([good])
+
+
+def test_jl002_does_not_flag_pure_functional_update():
+    # optax's tx.update is pure despite its name: result is consumed.
+    good = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def step(grads, opt_state, params):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return updates, opt_state
+
+        fast = jax.jit(step)
+        """,
+    )
+    assert not _checks(jaxlint.run([good]), "JL002")
+
+
+def test_jl003_hot_module_blocking_transfer():
+    code = """
+        import jax
+
+        def fetch(ref):
+            host = jax.device_get(ref)
+            ref.block_until_ready()
+            return host
+        """
+    hot = _src("pkg/serve/batcher.py", code)
+    cold = _src("pkg/util/debug.py", code)
+    assert len(_checks(jaxlint.run([hot]), "JL003")) == 2
+    assert not jaxlint.run([cold])
+
+
+def test_jl004_flags_tracer_branch_and_passes_laundered():
+    bad = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def f(x):
+            y = x * 2
+            if y > 0:
+                return y
+            return -y
+
+        g = jax.jit(f)
+        """,
+    )
+    good = _src(
+        "pkg/mod.py",
+        """
+        import jax
+
+        def f(x, state):
+            if x.shape[0] > 2:
+                x = x * 2
+            if state is None:
+                return x
+            n = len(x)
+            if n > 1:
+                return x
+            return x
+
+        g = jax.jit(f)
+        """,
+    )
+    assert _checks(jaxlint.run([bad]), "JL004")
+    assert not _checks(jaxlint.run([good]), "JL004")
+
+
+# ----------------------------------------------------------------- locklint
+
+
+def test_ll001_flags_bare_acquire_and_passes_with():
+    bad = _src(
+        "pkg/mod.py",
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def f():
+            _LOCK.acquire()
+            try:
+                pass
+            finally:
+                _LOCK.release()
+        """,
+    )
+    good = _src(
+        "pkg/mod.py",
+        """
+        import threading
+
+        _LOCK = threading.Lock()
+
+        def f():
+            with _LOCK:
+                pass
+        """,
+    )
+    assert len(_checks(locklint.run([bad]), "LL001")) == 2
+    assert not locklint.run([good])
+
+
+def test_ll001_semaphores_are_exempt():
+    good = _src(
+        "pkg/mod.py",
+        """
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._sem = threading.BoundedSemaphore(2)
+
+            def enter(self):
+                self._sem.acquire()
+
+            def leave(self):
+                self._sem.release()
+        """,
+    )
+    assert not _checks(locklint.run([good]), "LL001")
+
+
+def test_ll002_flags_blocking_under_lock():
+    bad = _src(
+        "pkg/mod.py",
+        """
+        import queue
+        import threading
+        import time
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad_get(self):
+                with self._lock:
+                    return self._q.get(timeout=1)
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.1)
+        """,
+    )
+    found = _checks(locklint.run([bad]), "LL002")
+    assert len(found) == 2
+    assert {f.scope for f in found} == {"B.bad_get", "B.bad_sleep"}
+
+
+def test_ll002_passes_condition_self_wait_and_dict_get():
+    good = _src(
+        "pkg/mod.py",
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._lock = threading.Lock()
+                self._cache = {}
+
+            def waiter(self):
+                with self._cv:
+                    self._cv.wait(timeout=0.1)
+
+            def lookup(self, k):
+                with self._lock:
+                    return self._cache.get(k)
+        """,
+    )
+    assert not _checks(locklint.run([good]), "LL002")
+
+
+def test_ll003_thread_lifecycle():
+    bad = _src(
+        "pkg/mod.py",
+        """
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+        """,
+    )
+    good_daemon = _src(
+        "pkg/mod.py",
+        """
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+        """,
+    )
+    good_joined = _src(
+        "pkg/mod.py",
+        """
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def close(self):
+                self._t.join(timeout=5.0)
+        """,
+    )
+    assert _checks(locklint.run([bad]), "LL003")
+    assert not _checks(locklint.run([good_daemon]), "LL003")
+    assert not _checks(locklint.run([good_joined]), "LL003")
+
+
+# --------------------------------------------------------------- shardcheck
+
+_MESH_FIXTURE = """
+    AXIS_ORDER = ("replica", "data", "pipeline", "expert", "seq", "model")
+"""
+
+
+def test_sc001_flags_undeclared_axis_and_passes_declared():
+    mesh = _src("pkg/parallel/mesh.py", _MESH_FIXTURE)
+    bad = _src(
+        "pkg/mod.py",
+        """
+        from jax.sharding import PartitionSpec as P
+        from jax import lax
+
+        spec = P("model", "bogus")
+
+        def f(x):
+            return lax.psum(x, "tensor")
+        """,
+    )
+    good = _src(
+        "pkg/mod.py",
+        """
+        from jax.sharding import PartitionSpec as P
+        from jax import lax
+
+        spec = P("data", ("expert", "model"))
+
+        def f(x, mesh):
+            n = mesh.shape.get("model", 1)
+            return lax.psum(x, "seq") + n
+        """,
+    )
+    found = _checks(shardcheck.run([mesh, bad]), "SC001")
+    assert {m for f in found for m in [f.message] if "'bogus'" in m}
+    assert any("'tensor'" in f.message for f in found)
+    assert len(found) == 2
+    assert not shardcheck.run([mesh, good])
+
+
+def test_sc001_real_package_axes_all_declared():
+    from distributed_tensorflow_tpu.analysis.findings import iter_sources
+
+    sources = iter_sources(REPO_ROOT)
+    axes = shardcheck.declared_axes(sources)
+    assert axes == {"replica", "data", "pipeline", "expert", "seq", "model"}
+    assert not shardcheck.run(sources)
+
+
+def test_sc002_layout_sweep_proves_fallback_not_crash():
+    findings, matrix = shardcheck.run_config_sweep()
+    assert not findings
+    by_layout = {(c["tp"], c["pp"], c["ep"]): c["outcome"] for c in matrix}
+    # CLI defaults and the parity layouts serve.
+    assert by_layout[(1, 1, 1)] == "serves"
+    assert by_layout[(2, 1, 1)] == "serves"
+    assert by_layout[(4, 1, 1)] == "serves"
+    # PR-7 contract: oversized / non-dividing meshes warn and fall back —
+    # statically proven here, not just at runtime.
+    assert by_layout[(16, 1, 1)] == "falls_back"
+    assert by_layout[(3, 1, 1)] == "falls_back"
+    # Infeasible model/layout combos die in a clean ValueError, never XLA.
+    assert by_layout[(1, 1, 4)] == "rejects"
+
+
+# ---------------------------------------------------------------- sanitizer
+
+
+def test_sanitizer_seeded_cycle_detected():
+    with sanitize_locks() as san:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        cycles = san.cycles()
+        assert cycles, san.report()
+        with pytest.raises(AssertionError):
+            san.assert_no_cycles()
+    # Patch restored on exit: locks are stdlib _thread.lock again.
+    assert type(threading.Lock()).__name__ in ("lock", "LockType")
+
+
+def test_sanitizer_consistent_order_is_clean():
+    with sanitize_locks() as san:
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(5):
+            with a:
+                with b:
+                    pass
+        assert san.acquisitions == 10
+        assert not san.cycles()
+        san.assert_no_cycles()
+
+
+def test_sanitizer_tracks_stdlib_queue_condition_event():
+    import queue
+
+    with sanitize_locks() as san:
+        q = queue.Queue(maxsize=2)
+        cv = threading.Condition()
+        done = threading.Event()
+
+        def worker():
+            for i in range(20):
+                q.put(i)
+            with cv:
+                cv.notify_all()
+            done.set()
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        got = [q.get() for _ in range(20)]
+        with cv:
+            cv.wait(timeout=0.2)
+        assert done.wait(timeout=5)
+        t.join(timeout=5)
+        assert got == list(range(20))
+        assert san.acquisitions > 0
+        san.assert_no_cycles()
+
+
+# ------------------------------------------------------------------- runner
+
+
+def _run_analyze(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "analyze.py"), *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+
+
+def test_analyze_quick_is_clean_and_fast():
+    t0 = time.monotonic()
+    proc = _run_analyze("--quick", "--format", "json")
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["ok"]
+    assert report["active"] == []
+    assert report["stale_baseline"] == []
+    # The honest-baseline satellite: suppressions exist and carry reasons.
+    assert report["suppressed"]
+    assert all(s["reason"] for s in report["suppressed"])
+    assert elapsed < 30.0, f"quick mode took {elapsed:.1f}s (budget 30s)"
+
+
+def test_analyze_seeded_violation_exits_nonzero(tmp_path):
+    pkg = tmp_path / "seeded_pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def leak():
+                _LOCK.acquire()
+                return 1
+            """
+        )
+    )
+    proc = _run_analyze(
+        "--root", str(tmp_path),
+        "--package", "seeded_pkg",
+        "--quick", "--no-baseline", "--format", "json",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert any(f["check"] == "LL001" for f in report["active"])
+
+
+def test_stale_baseline_entries_fail_only_for_checks_run():
+    baseline = Baseline(
+        entries={
+            "LL001:pkg/gone.py:f": "obsolete",
+            "SC002:pkg/other.py:g": "config-stage suppression",
+        }
+    )
+    result = apply_baseline([], baseline, checks_run=["LL001"])
+    # LL001 ran and its entry matched nothing -> stale; SC002 did not run
+    # (quick mode) so its entry must NOT be reported stale.
+    assert result.stale == ["LL001:pkg/gone.py:f"]

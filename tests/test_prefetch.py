@@ -27,6 +27,7 @@ from distributed_tensorflow_tpu.data.text import (
 )
 from distributed_tensorflow_tpu.models import LeNet5
 from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
+from distributed_tensorflow_tpu.obs.sanitizer import sanitize_locks
 from distributed_tensorflow_tpu.parallel.mesh import build_mesh
 from distributed_tensorflow_tpu.train import create_train_state, fit, make_train_step
 from distributed_tensorflow_tpu.train.objectives import (
@@ -235,7 +236,9 @@ def test_native_pipeline_stream_bit_identical(data_mesh):
 @pytest.mark.slow
 def test_prefetch_soak_order_and_shutdown():
     """Soak: jittery producer + jittery consumer, order preserved end-to-end
-    and shutdown clean mid-stream (multi-second; slow-marked)."""
+    and shutdown clean mid-stream (multi-second; slow-marked). Runs under
+    the lock-order sanitizer: feeder-thread queue/event locks must form an
+    acyclic acquisition graph over the whole soak."""
     rng = np.random.default_rng(0)
     delays = rng.uniform(0.0, 0.004, size=400)
 
@@ -244,18 +247,44 @@ def test_prefetch_soak_order_and_shutdown():
             time.sleep(d)
             yield i
 
-    it = prefetch(jittery(), 4)
-    seen = []
-    for i, v in enumerate(it):
-        seen.append(v)
-        if i % 7 == 0:
-            time.sleep(0.003)
-    assert seen == list(range(400))
-    it.close()
-    # And a mid-stream close on a fresh iterator must not hang.
-    it2 = prefetch(jittery(), 4)
-    for _ in range(25):
-        next(it2)
-    t0 = time.perf_counter()
-    it2.close()
-    assert time.perf_counter() - t0 < 6.0
+    with sanitize_locks() as san:
+        it = prefetch(jittery(), 4)
+        seen = []
+        for i, v in enumerate(it):
+            seen.append(v)
+            if i % 7 == 0:
+                time.sleep(0.003)
+        assert seen == list(range(400))
+        it.close()
+        # And a mid-stream close on a fresh iterator must not hang.
+        it2 = prefetch(jittery(), 4)
+        for _ in range(25):
+            next(it2)
+        t0 = time.perf_counter()
+        it2.close()
+        assert time.perf_counter() - t0 < 6.0
+        assert san.acquisitions > 0
+        san.assert_no_cycles()
+
+
+def test_prefetch_sanitized_mini_soak():
+    """Fast tier-1 cousin of the slow soak: a short jittery run under the
+    lock-order sanitizer so every CI run checks the feeder/queue lock
+    ordering, not just slow-marked ones."""
+    def jittery():
+        for i in range(60):
+            if i % 9 == 0:
+                time.sleep(0.001)
+            yield i
+
+    with sanitize_locks() as san:
+        it = prefetch(jittery(), 3)
+        assert list(it) == list(range(60))
+        it.close()
+        # Mid-stream close path too (exercises drain + join under tracking).
+        it2 = prefetch(jittery(), 3)
+        for _ in range(10):
+            next(it2)
+        it2.close()
+        assert san.acquisitions > 0
+        san.assert_no_cycles()

@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.obs.sanitizer import sanitize_locks
 from distributed_tensorflow_tpu.serve import (
     BatcherConfig,
     Client,
@@ -179,65 +180,76 @@ def test_bucket_queue_backpressure_counts_all_buckets():
 
 def test_max_in_flight_overlaps_dispatch():
     """With max_in_flight=2 the flusher dispatches batch k+1 while batch k
-    is still unfetched; with 1 it never does."""
-    for depth, want_overlap in ((2, 2), (1, 1)):
-        gate = threading.Event()
-        eng = _PipelinedStub(fetch_gate=gate)
-        m = ServeMetrics()
-        cfg = BatcherConfig(
-            max_batch=1, max_delay_ms=0.0, max_in_flight=depth
-        )
-        b = DynamicBatcher(
-            eng.run_batch, cfg, m, dispatch=eng.dispatch, fetch=eng.fetch
-        )
-        try:
-            futs = [b.submit(i) for i in range(4)]
-            deadline = time.monotonic() + 5
-            while eng.dispatched < want_overlap and time.monotonic() < deadline:
-                time.sleep(0.005)
-            # The gate is still closed: nothing fetched yet, so dispatched
-            # == in-flight. Depth 2 pipelines; depth 1 stays serial.
-            assert eng.dispatched == want_overlap
-            gate.set()
-            assert [f.result(timeout=5)["v"] for f in futs] == [0, 1, 2, 3]
-            assert eng.max_overlap == want_overlap
-        finally:
-            gate.set()
-            b.close()
+    is still unfetched; with 1 it never does. The whole exercise runs under
+    the lock-order sanitizer: every batcher/metrics lock is tracked and the
+    acquisition graph must stay acyclic."""
+    with sanitize_locks() as san:
+        for depth, want_overlap in ((2, 2), (1, 1)):
+            gate = threading.Event()
+            eng = _PipelinedStub(fetch_gate=gate)
+            m = ServeMetrics()
+            cfg = BatcherConfig(
+                max_batch=1, max_delay_ms=0.0, max_in_flight=depth
+            )
+            b = DynamicBatcher(
+                eng.run_batch, cfg, m, dispatch=eng.dispatch, fetch=eng.fetch
+            )
+            try:
+                futs = [b.submit(i) for i in range(4)]
+                deadline = time.monotonic() + 5
+                while eng.dispatched < want_overlap and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                # The gate is still closed: nothing fetched yet, so dispatched
+                # == in-flight. Depth 2 pipelines; depth 1 stays serial.
+                assert eng.dispatched == want_overlap
+                gate.set()
+                assert [f.result(timeout=5)["v"] for f in futs] == [0, 1, 2, 3]
+                assert eng.max_overlap == want_overlap
+            finally:
+                gate.set()
+                b.close()
+        assert san.acquisitions > 0
+        san.assert_no_cycles()
 
 
 def test_pipelined_results_ordered_under_concurrent_submits():
-    eng = _PipelinedStub()
-    cfg = BatcherConfig(
-        max_batch=3, max_delay_ms=1.0, max_in_flight=2, max_queue=256
-    )
-    b = DynamicBatcher(
-        eng.run_batch, cfg, dispatch=eng.dispatch, fetch=eng.fetch
-    )
-    results = {}
-    errs = []
+    with sanitize_locks() as san:
+        eng = _PipelinedStub()
+        cfg = BatcherConfig(
+            max_batch=3, max_delay_ms=1.0, max_in_flight=2, max_queue=256
+        )
+        b = DynamicBatcher(
+            eng.run_batch, cfg, dispatch=eng.dispatch, fetch=eng.fetch
+        )
+        results = {}
+        errs = []
 
-    def worker(base):
-        try:
-            futs = [(base + i, b.submit(base + i)) for i in range(20)]
-            for v, f in futs:
-                results[v] = f.result(timeout=10)["v"]
-        except Exception as e:  # pragma: no cover - surfaced via errs
-            errs.append(e)
+        def worker(base):
+            try:
+                futs = [(base + i, b.submit(base + i)) for i in range(20)]
+                for v, f in futs:
+                    results[v] = f.result(timeout=10)["v"]
+            except Exception as e:  # pragma: no cover - surfaced via errs
+                errs.append(e)
 
-    threads = [
-        threading.Thread(target=worker, args=(base,))
-        for base in (0, 100, 200, 300)
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=30)
-    b.close()
-    assert not errs
-    # Every request got ITS OWN result back, across interleaved batches.
-    assert results == {v: v for v in results}
-    assert len(results) == 80
+        threads = [
+            threading.Thread(target=worker, args=(base,))
+            for base in (0, 100, 200, 300)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        b.close()
+        assert not errs
+        # Every request got ITS OWN result back, across interleaved batches.
+        assert results == {v: v for v in results}
+        assert len(results) == 80
+        # 4 submitters x 20 requests through flusher + completion threads:
+        # the recorded acquisition order over the batcher's cv / queue /
+        # semaphore / metrics locks must be cycle-free.
+        assert san.acquisitions > 0
+        san.assert_no_cycles()
 
 
 def test_pipelined_dispatch_failure_is_isolated():
