@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-full
+.PHONY: test test-all analyze analyze-diff analyze-full
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -16,6 +16,12 @@ test-all:
 # non-baselined finding or stale baseline entry.
 analyze:
 	$(PY) scripts/analyze.py --quick
+
+# Pre-commit shape: AST checkers over files changed vs REF only (default
+# HEAD~1), plus untracked files. Override with `make analyze-diff REF=main`.
+REF ?= HEAD~1
+analyze-diff:
+	$(PY) scripts/analyze.py --quick --diff $(REF)
 
 analyze-full:
 	$(PY) scripts/analyze.py

@@ -4,9 +4,10 @@ Checkers:
 
 * :mod:`.jaxlint`   — JAX correctness pitfalls (JL001–JL004)
 * :mod:`.locklint`  — static concurrency rules (LL001–LL003)
+* :mod:`.racelint`  — cross-thread shared-state rules (RC001–RC003)
 * :mod:`.shardcheck`— mesh-axis and serving-layout validation (SC001–SC002)
 
-plus the runtime lock-order sanitizer in
+plus the runtime lock-order + data-race sanitizers in
 :mod:`distributed_tensorflow_tpu.obs.sanitizer`. Run everything via
 ``scripts/analyze.py``; see ``docs/ANALYSIS.md`` for the check catalog and
 baseline workflow.

@@ -270,7 +270,10 @@ def _check_thread_lifecycle(src: SourceFile, scopes: ScopeIndex) -> list[Finding
 
 def _has_timeout_join_or_daemon_attr(tree: ast.Module, ctor: ast.Call) -> bool:
     # Find the name the Thread was bound to (self.X = Thread(...) or X = ...).
+    # A comprehension binding — self._ts = [Thread(...) for _ in ...] — makes
+    # the target a handle *collection* rather than a handle.
     handles: set[str] = set()
+    colls: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and node.value is ctor:
             for tgt in node.targets:
@@ -281,8 +284,18 @@ def _has_timeout_join_or_daemon_attr(tree: ast.Module, ctor: ast.Call) -> bool:
             name = dotted_name(node.target)
             if name:
                 handles.add(name)
-    if not handles:
+        elif (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, (ast.ListComp, ast.SetComp, ast.GeneratorExp))
+            and node.value.elt is ctor
+        ):
+            for tgt in node.targets:
+                name = dotted_name(tgt)
+                if name:
+                    colls.add(name)
+    if not handles and not colls:
         return False
+    _propagate_handles(tree, handles, colls)
     for node in ast.walk(tree):
         if (
             isinstance(node, ast.Call)
@@ -303,3 +316,72 @@ def _has_timeout_join_or_daemon_attr(tree: ast.Module, ctor: ast.Call) -> bool:
         ):
             return True
     return False
+
+
+def _propagate_handles(
+    tree: ast.Module, handles: set[str], colls: set[str] | None = None
+) -> None:
+    """Grow ``handles`` with indirect bindings of the same thread objects.
+
+    The direct rule only sees ``self._t = Thread(...)`` ... ``self._t.join(
+    timeout)``. Real shutdown paths are often indirect: workers collected
+    into a list joined by a ``close()``/``shutdown()`` helper (itself called
+    from ``finally``/``__exit__``), or handles returned from a spawn helper.
+    Fixpoint over three propagation steps:
+
+    * alias/return: ``x = h`` and ``y = self._spawn()`` where ``_spawn``
+      returns a handle make ``x``/``y`` handles;
+    * collection: ``self._workers.append(h)`` / ``ws = [h1, h2]`` mark the
+      container;
+    * iteration: ``for w in self._workers:`` makes the loop variable a
+      handle, so ``w.join(timeout=...)`` counts.
+    """
+    colls = set() if colls is None else colls
+    while True:
+        changed = False
+
+        def note(bucket: set[str], name: str | None) -> None:
+            nonlocal changed
+            if name and name not in bucket:
+                bucket.add(name)
+                changed = True
+
+        returners = {
+            fn.name
+            for fn in ast.walk(tree)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and any(
+                isinstance(sub, ast.Return)
+                and sub.value is not None
+                and dotted_name(sub.value) in handles
+                for sub in ast.walk(fn)
+            )
+        }
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                val = node.value
+                tgt_names = [dotted_name(t) for t in node.targets]
+                callee = (
+                    (dotted_name(val.func) or "").split(".")[-1]
+                    if isinstance(val, ast.Call)
+                    else ""
+                )
+                if dotted_name(val) in handles or callee in returners:
+                    for name in tgt_names:
+                        note(handles, name)
+                elif isinstance(val, (ast.List, ast.Tuple, ast.Set)) and any(
+                    dotted_name(e) in handles for e in val.elts
+                ):
+                    for name in tgt_names:
+                        note(colls, name)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in {"append", "add", "insert"}
+                and any(dotted_name(a) in handles for a in node.args)
+            ):
+                note(colls, dotted_name(node.func.value))
+            elif isinstance(node, ast.For) and dotted_name(node.iter) in colls:
+                note(handles, dotted_name(node.target))
+        if not changed:
+            return

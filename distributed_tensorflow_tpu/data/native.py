@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import subprocess
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -27,32 +28,43 @@ logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libdata_pipeline.so"
+# _load() is reached both from the main thread (native_available probes)
+# and from prefetch feeder threads first touching a NativePipeline; the
+# lock keeps the lazy check-then-build-then-publish atomic so two threads
+# can never race concurrent `make -B` builds of the same .so.
+_LOAD_LOCK = threading.Lock()
 _lib = None
 _build_failed = False
 
 
 def _load() -> ctypes.CDLL | None:
     global _lib, _build_failed
-    if _lib is not None:
-        return _lib
-    if _build_failed:
-        return None
-    src = _NATIVE_DIR / "data_pipeline.cpp"
-    if not _LIB_PATH.exists() or (
-        src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
-    ):
-        try:
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR), "-B"],
-                check=True,
-                capture_output=True,
-                text=True,
-            )
-        except (subprocess.CalledProcessError, FileNotFoundError) as e:
-            logger.warning("native pipeline build failed, using numpy path: %s", e)
-            _build_failed = True
+    with _LOAD_LOCK:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
             return None
-    lib = ctypes.CDLL(str(_LIB_PATH))
+        src = _NATIVE_DIR / "data_pipeline.cpp"
+        if not _LIB_PATH.exists() or (
+            src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+        ):
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR), "-B"],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                logger.warning(
+                    "native pipeline build failed, using numpy path: %s", e
+                )
+                _build_failed = True
+                return None
+        return _bind(ctypes.CDLL(str(_LIB_PATH)))
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dp_create.restype = ctypes.c_void_p
     lib.dp_create.argtypes = [
         ctypes.c_void_p,  # images
@@ -73,6 +85,7 @@ def _load() -> ctypes.CDLL | None:
     lib.dp_next.restype = ctypes.c_int
     lib.dp_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
     lib.dp_destroy.argtypes = [ctypes.c_void_p]
+    global _lib
     _lib = lib
     return _lib
 

@@ -57,6 +57,10 @@ class PrefetchIterator:
     construction).
     """
 
+    # Watched by obs.sanitizer.sanitize_races in the prefetch soaks:
+    # consumer-side flags (_done) plus the close handshake (_closed).
+    _RACETRACE_ATTRS = ("_done", "_closed")
+
     def __init__(
         self,
         source: Iterable,
@@ -73,6 +77,11 @@ class PrefetchIterator:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._done = False
+        # _close_lock makes close() idempotent under concurrent callers:
+        # only the winner of the closed check runs the drain/join sequence.
+        # The drain itself stays OUTSIDE the lock — holding it across
+        # Thread.join would reintroduce the blocking-under-lock hazard.
+        self._close_lock = threading.Lock()
         self._closed = False
         self._thread = threading.Thread(target=self._feed, name=name, daemon=True)
         self._thread.start()
@@ -141,9 +150,10 @@ class PrefetchIterator:
 
     def close(self, join_timeout_s: float = 5.0) -> None:
         """Stop the feeder and close the wrapped producer (idempotent)."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
         # Drain buffered batches so a feeder blocked in put() wakes promptly
         # (its 50 ms poll would also catch the stop flag) and device/host

@@ -26,7 +26,9 @@ from distributed_tensorflow_tpu.obs.profile import (  # noqa: F401
 )
 from distributed_tensorflow_tpu.obs.sanitizer import (  # noqa: F401
     LockOrderSanitizer,
+    RaceSanitizer,
     sanitize_locks,
+    sanitize_races,
 )
 from distributed_tensorflow_tpu.obs.trace import (  # noqa: F401
     NULL_TRACER,

@@ -97,6 +97,10 @@ class DynamicBatcher:
     completion thread fetches, bounded by ``config.max_in_flight``.
     """
 
+    # Shared mutable state watched by obs.sanitizer.sanitize_races in the
+    # pipelining tests; every access must be ordered by self._cv.
+    _RACETRACE_ATTRS = ("_queues", "_count", "_closed", "_n_inflight")
+
     def __init__(
         self,
         run_batch: Callable[[list], Sequence],

@@ -7,6 +7,7 @@ composes, errors propagate promptly, and training trajectories are
 unchanged.
 """
 
+import sys
 import time
 
 import jax
@@ -27,7 +28,7 @@ from distributed_tensorflow_tpu.data.text import (
 )
 from distributed_tensorflow_tpu.models import LeNet5
 from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
-from distributed_tensorflow_tpu.obs.sanitizer import sanitize_locks
+from distributed_tensorflow_tpu.obs.sanitizer import sanitize_locks, sanitize_races
 from distributed_tensorflow_tpu.parallel.mesh import build_mesh
 from distributed_tensorflow_tpu.train import create_train_state, fit, make_train_step
 from distributed_tensorflow_tpu.train.objectives import (
@@ -237,8 +238,9 @@ def test_native_pipeline_stream_bit_identical(data_mesh):
 def test_prefetch_soak_order_and_shutdown():
     """Soak: jittery producer + jittery consumer, order preserved end-to-end
     and shutdown clean mid-stream (multi-second; slow-marked). Runs under
-    the lock-order sanitizer: feeder-thread queue/event locks must form an
-    acyclic acquisition graph over the whole soak."""
+    the race sanitizer: feeder-thread queue/event locks must form an
+    acyclic acquisition graph AND every access to the iterator's declared
+    shared state (_RACETRACE_ATTRS) must be happens-before ordered."""
     rng = np.random.default_rng(0)
     delays = rng.uniform(0.0, 0.004, size=400)
 
@@ -247,7 +249,7 @@ def test_prefetch_soak_order_and_shutdown():
             time.sleep(d)
             yield i
 
-    with sanitize_locks() as san:
+    with sanitize_races(modules=[sys.modules[PrefetchIterator.__module__]]) as san:
         it = prefetch(jittery(), 4)
         seen = []
         for i, v in enumerate(it):
@@ -264,20 +266,22 @@ def test_prefetch_soak_order_and_shutdown():
         it2.close()
         assert time.perf_counter() - t0 < 6.0
         assert san.acquisitions > 0
-        san.assert_no_cycles()
+        assert san.accesses > 0
+        san.assert_clean()
 
 
 def test_prefetch_sanitized_mini_soak():
     """Fast tier-1 cousin of the slow soak: a short jittery run under the
-    lock-order sanitizer so every CI run checks the feeder/queue lock
-    ordering, not just slow-marked ones."""
+    race sanitizer so every CI run checks the feeder/queue lock ordering
+    and the happens-before ordering of the iterator's shared state, not
+    just slow-marked ones."""
     def jittery():
         for i in range(60):
             if i % 9 == 0:
                 time.sleep(0.001)
             yield i
 
-    with sanitize_locks() as san:
+    with sanitize_races(modules=[sys.modules[PrefetchIterator.__module__]]) as san:
         it = prefetch(jittery(), 3)
         assert list(it) == list(range(60))
         it.close()
@@ -287,4 +291,5 @@ def test_prefetch_sanitized_mini_soak():
             next(it2)
         it2.close()
         assert san.acquisitions > 0
-        san.assert_no_cycles()
+        assert san.accesses > 0
+        san.assert_clean()

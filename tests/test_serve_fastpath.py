@@ -21,7 +21,8 @@ import numpy as np
 import pytest
 
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
-from distributed_tensorflow_tpu.obs.sanitizer import sanitize_locks
+from distributed_tensorflow_tpu.obs.sanitizer import sanitize_locks, sanitize_races
+from distributed_tensorflow_tpu.serve import batcher as batcher_mod
 from distributed_tensorflow_tpu.serve import (
     BatcherConfig,
     Client,
@@ -181,9 +182,11 @@ def test_bucket_queue_backpressure_counts_all_buckets():
 def test_max_in_flight_overlaps_dispatch():
     """With max_in_flight=2 the flusher dispatches batch k+1 while batch k
     is still unfetched; with 1 it never does. The whole exercise runs under
-    the lock-order sanitizer: every batcher/metrics lock is tracked and the
-    acquisition graph must stay acyclic."""
-    with sanitize_locks() as san:
+    the race sanitizer: every batcher/metrics lock is tracked, the
+    acquisition graph must stay acyclic, AND every access to the batcher's
+    declared shared state (_RACETRACE_ATTRS) must be happens-before
+    ordered."""
+    with sanitize_races(modules=[batcher_mod]) as san:
         for depth, want_overlap in ((2, 2), (1, 1)):
             gate = threading.Event()
             eng = _PipelinedStub(fetch_gate=gate)
@@ -209,11 +212,12 @@ def test_max_in_flight_overlaps_dispatch():
                 gate.set()
                 b.close()
         assert san.acquisitions > 0
-        san.assert_no_cycles()
+        assert san.accesses > 0
+        san.assert_clean()
 
 
 def test_pipelined_results_ordered_under_concurrent_submits():
-    with sanitize_locks() as san:
+    with sanitize_races(modules=[batcher_mod]) as san:
         eng = _PipelinedStub()
         cfg = BatcherConfig(
             max_batch=3, max_delay_ms=1.0, max_in_flight=2, max_queue=256
@@ -247,9 +251,50 @@ def test_pipelined_results_ordered_under_concurrent_submits():
         assert len(results) == 80
         # 4 submitters x 20 requests through flusher + completion threads:
         # the recorded acquisition order over the batcher's cv / queue /
-        # semaphore / metrics locks must be cycle-free.
+        # semaphore / metrics locks must be cycle-free, and every watched
+        # shared-state access must be ordered by a happens-before edge.
         assert san.acquisitions > 0
-        san.assert_no_cycles()
+        assert san.accesses > 0
+        san.assert_clean()
+
+
+def test_racetrace_overhead_within_ten_percent():
+    """Acceptance bound: running the pipelined workload under the race
+    sanitizer costs <= 10% wall-clock vs the same workload untracked.
+    The workload is deliberately sleep-paced (as real serving is device-
+    paced) so the bound is about instrumentation cost on the hot path, not
+    about raw python dispatch."""
+
+    class _SleepyStub(_PipelinedStub):
+        def fetch(self, handle):
+            time.sleep(0.002)  # stands in for device time
+            return super().fetch(handle)
+
+    def workload() -> float:
+        eng = _SleepyStub()
+        cfg = BatcherConfig(
+            max_batch=4, max_delay_ms=0.5, max_in_flight=2, max_queue=256
+        )
+        t0 = time.monotonic()
+        b = DynamicBatcher(
+            eng.run_batch, cfg, dispatch=eng.dispatch, fetch=eng.fetch
+        )
+        futs = [b.submit(i) for i in range(120)]
+        assert [f.result(timeout=30)["v"] for f in futs] == list(range(120))
+        b.close()
+        return time.monotonic() - t0
+
+    workload()  # warm-up: imports, thread machinery
+    plain = min(workload() for _ in range(3))
+    with sanitize_races(modules=[batcher_mod]) as san:
+        traced = min(workload() for _ in range(3))
+        san.assert_clean()
+    assert san.accesses > 0
+    # 10% + 20ms absolute slack so scheduler jitter on a loaded CI host
+    # can't fail a bound the steady-state comfortably meets.
+    assert traced <= plain * 1.10 + 0.020, (
+        f"racetrace overhead too high: plain={plain:.3f}s traced={traced:.3f}s"
+    )
 
 
 def test_pipelined_dispatch_failure_is_isolated():
