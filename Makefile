@@ -4,13 +4,21 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick
 
 test:
 	$(PY) -m pytest tests/ -q
 
 test-all:
 	$(PY) -m pytest tests/ -q -m ""
+
+# Observability fast lane: windowed-metrics/SLO/health/fleet unit tests
+# plus the serve_bench quick gate (phase-sum invariant + windowed-vs-exact
+# SLO attainment <=2%).
+obs-quick:
+	$(PY) -m pytest tests/test_timeseries.py tests/test_slo.py \
+	    tests/test_serve_health.py tests/test_fleet.py -q
+	$(PY) scripts/serve_bench.py --quick
 
 # Static analysis + config sweep over the package; nonzero exit on any
 # non-baselined finding or stale baseline entry.

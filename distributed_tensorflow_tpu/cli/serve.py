@@ -70,7 +70,7 @@ def build_serving_client(cfg, args):
 
     from distributed_tensorflow_tpu.ckpt import restore_serving_state
     from distributed_tensorflow_tpu.cli.train import _make_tx
-    from distributed_tensorflow_tpu.obs import ServeMetrics
+    from distributed_tensorflow_tpu.obs.slo import SloSpec
     from distributed_tensorflow_tpu.parallel.mesh import (
         build_mesh,
         data_axes,
@@ -130,7 +130,6 @@ def build_serving_client(cfg, args):
         cfg.name, step, dict(mesh.shape),
     )
 
-    metrics = ServeMetrics()
     if "image_shape" in pieces:
         shape = pieces["image_shape"]
         engine = ImageClassifierEngine(
@@ -166,6 +165,14 @@ def build_serving_client(cfg, args):
     # Span tracing is always-on-capable: --trace-buffer 0 turns it into
     # branch-cheap no-ops at every call site.
     buf = getattr(args, "trace_buffer", 4096)
+    # Declared SLOs drive /sloz burn rates and the /healthz degraded
+    # overlay; the Client inserts the latency threshold as an explicit
+    # histogram bound so windowed attainment at it is exact.
+    slo = SloSpec(
+        latency_threshold_ms=getattr(args, "slo_p99_ms", 0.0),
+        latency_target=getattr(args, "slo_target", 0.99),
+        availability_target=getattr(args, "slo_availability", 0.0),
+    )
     client = Client(
         engine,
         BatcherConfig(
@@ -175,8 +182,8 @@ def build_serving_client(cfg, args):
             max_in_flight=args.max_in_flight,
             bucket_queues=args.bucket_queues,
         ),
-        metrics=metrics,
         tracer=Tracer(buffer_size=buf, enabled=buf > 0),
+        slo=slo,
     )
     return client, make_payload
 
@@ -260,6 +267,18 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--image-size", type=int, default=0)
     parser.add_argument("--staleness", type=int, default=-1,
                         help="training run's staleness (stale-mode ckpts)")
+    # Declared SLOs (0 disables a dimension): /sloz reports attainment +
+    # error-budget burn; a paging-level burn turns /healthz "degraded".
+    parser.add_argument("--slo-p99-ms", type=float, default=0.0,
+                        help="latency SLO threshold in ms: --slo-target of "
+                        "requests must complete within it (0 = no latency "
+                        "SLO)")
+    parser.add_argument("--slo-target", type=float, default=0.99,
+                        help="target fraction for the latency SLO "
+                        "(e.g. 0.99 = p99 under --slo-p99-ms)")
+    parser.add_argument("--slo-availability", type=float, default=0.0,
+                        help="availability SLO target fraction, e.g. 0.999 "
+                        "(0 = no availability SLO)")
     parser.add_argument("--trace-dir", default="",
                         help="where POST /profilez drops jax.profiler "
                         "captures; also receives a Chrome span trace at "
@@ -304,8 +323,9 @@ def main(argv: list[str] | None = None):
             client, args.host, args.port, trace_dir=args.trace_dir or None
         )
         logger.info(
-            "ready on http://%s:%d (POST /v1/%s; GET /statusz /tracez, "
-            "POST /profilez)",
+            "ready on http://%s:%d (POST /v1/%s; GET /healthz /sloz "
+            "/statusz /tracez /metrics?format=prom, POST /profilez "
+            "/drainz)",
             *server.server_address,
             "classify" if hasattr(client.engine, "image_shape") else "mlm",
         )

@@ -796,6 +796,24 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
             metrics["lr"] = float(lr_schedule(step_ - 1))
 
     hook = make_metric_hook(logdir=args.tb_dir, jsonl=args.metrics_jsonl)
+
+    # Fleet health beacon (--beacon-dir): per-step timeline + straggler
+    # detector feeding one atomically-replaced JSON file per host, refreshed
+    # at the log cadence. Aggregation is pull-based (obs/fleet.py
+    # read_beacons / fleet_summary) — hosts never talk to each other.
+    timeline = None
+    hooks = (lr_hook, hook)
+    beacon_dir = getattr(args, "beacon_dir", "") or ""
+    if beacon_dir:
+        from distributed_tensorflow_tpu.obs.fleet import HostBeacon, StepTimeline
+
+        timeline = StepTimeline()
+        beacon = HostBeacon(beacon_dir, jax.process_index(), timeline)
+
+        def beacon_hook(step_: int, state_, metrics_: dict) -> None:
+            beacon.write()
+
+        hooks = (lr_hook, hook, beacon_hook)
     import contextlib
 
     # Host-side span tracing (obs/trace.py): ring-buffered step-phase
@@ -836,13 +854,14 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
                 num_steps=cfg.num_steps,
                 rng=make_rng(args.seed, args.rng_impl),
                 log_every=cfg.log_every,
-                hooks=(lr_hook, hook),
+                hooks=hooks,
                 checkpointer=ckpt,
                 ckpt_every=cfg.ckpt_every or args.ckpt_every,
                 evaluate=evaluate,
                 eval_every=args.eval_every,
                 feed_metrics=feed_metrics,
                 tracer=tracer,
+                timeline=timeline,
             )
         if ckpt is not None and ckpt.latest_step() != int(state.step):
             ckpt.save(int(state.step), state, force=True)
@@ -854,6 +873,8 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         close = getattr(batches, "close", None)
         if close is not None:
             close()
+        if timeline is not None:
+            beacon.write()  # final state, even for runs shorter than log_every
         if tracer is not None and jax.process_index() == 0:
             out = tracer.export(Path(trace_dir) / "train_trace.json")
             logging.info("wrote host span trace to %s", out)
@@ -948,6 +969,11 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--ckpt-every", type=int, default=0)
     parser.add_argument("--tb-dir", default="")
     parser.add_argument("--metrics-jsonl", default="")
+    parser.add_argument("--beacon-dir", default="",
+                        help="shared directory for per-host health beacons "
+                        "(host_<i>.json, atomically replaced at the log "
+                        "cadence): step-time/host-wait windows + straggler "
+                        "anomalies, aggregated by obs.fleet.fleet_summary")
     parser.add_argument("--profile-dir", default="",
                         help="capture an xprof trace of the whole run to this dir")
     parser.add_argument("--profile-steps", type=int, default=0,

@@ -8,6 +8,22 @@ JSONL by process 0; profiling is ``jax.profiler`` traces viewable in
 TensorBoard's profile plugin (xprof).
 """
 
+from distributed_tensorflow_tpu.obs.export import (  # noqa: F401
+    PROM_CONTENT_TYPE,
+    prometheus_text,
+)
+from distributed_tensorflow_tpu.obs.fleet import (  # noqa: F401
+    HostBeacon,
+    StepTimeline,
+    StragglerDetector,
+    detect_fleet_stragglers,
+    fleet_summary,
+    read_beacons,
+)
+from distributed_tensorflow_tpu.obs.health import (  # noqa: F401
+    HealthTracker,
+    http_status,
+)
 from distributed_tensorflow_tpu.obs.metrics import (  # noqa: F401
     Counter,
     FeedMetrics,
@@ -24,11 +40,22 @@ from distributed_tensorflow_tpu.obs.profile import (  # noqa: F401
     profile_window,
     trace_steps,
 )
+from distributed_tensorflow_tpu.obs.slo import (  # noqa: F401
+    SloSpec,
+    SloTracker,
+)
 from distributed_tensorflow_tpu.obs.sanitizer import (  # noqa: F401
     LockOrderSanitizer,
     RaceSanitizer,
     sanitize_locks,
     sanitize_races,
+)
+from distributed_tensorflow_tpu.obs.timeseries import (  # noqa: F401
+    DEFAULT_WINDOWS_S,
+    WindowedCounter,
+    WindowedHistogram,
+    WindowedHistogramFamily,
+    bounds_with,
 )
 from distributed_tensorflow_tpu.obs.trace import (  # noqa: F401
     NULL_TRACER,

@@ -1,0 +1,291 @@
+"""Fleet health: per-step timelines, straggler detection, host beacons.
+
+The paper's premise is a cluster that keeps making progress while
+individual roles degrade — this module is how a modern SPMD job *sees*
+that degradation (ROADMAP item 3's visibility substrate):
+
+- :class:`StepTimeline` — the train-loop recorder (``fit(timeline=...)``
+  feeds it): per-step wall/host-wait/dispatch durations into windowed
+  series (obs/timeseries.py) plus a bounded recent-step ring, with an
+  in-line :class:`StragglerDetector` flagging anomalies as they happen.
+- :class:`StragglerDetector` — self-relative anomaly detection: a step
+  is *slow* when it exceeds ``ratio`` x the trailing median of the
+  host's own recent steps; a *host-wait regression* is the analogous
+  test on the feed-wait series (with an absolute floor so microsecond
+  jitter on an idle feed never flags).  Trailing-median, not mean: one
+  checkpoint save must not shift the baseline.
+- :class:`HostBeacon` — the per-host health summary, written as one JSON
+  file per host (atomic rename) into a shared directory.  Processes
+  never talk to each other: the aggregation side —
+  :func:`read_beacons` / :func:`fleet_summary` /
+  :func:`detect_fleet_stragglers` — runs wherever the files are visible
+  (the chief, a monitor, the test harness).  A host is a *fleet*
+  straggler when its median step time exceeds ``ratio`` x the median of
+  the OTHER hosts' medians — cross-host-relative, so a uniformly slow
+  fleet (bigger model) flags nobody while one 5x host flags alone.
+
+No threads anywhere: recording is done by the train loop's own thread,
+beacon writes happen at the loop's log cadence, aggregation is pull.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+from distributed_tensorflow_tpu.obs.timeseries import (
+    DEFAULT_STEP_BOUNDS,
+    WindowedHistogram,
+)
+
+
+class StragglerDetector:
+    """Self-relative slow-step / feed-regression detector.
+
+    ``observe`` compares each step against the trailing median of the
+    PRIOR ``window`` steps (the current step never dilutes its own
+    baseline) and returns an anomaly record or ``None``.  Anomalies are
+    also kept in a bounded ring (``anomalies``) for the beacon.
+    """
+
+    def __init__(
+        self,
+        window: int = 64,
+        min_history: int = 8,
+        step_ratio: float = 3.0,
+        host_wait_ratio: float = 4.0,
+        min_host_wait_s: float = 0.005,
+        max_anomalies: int = 128,
+    ):
+        if window < min_history:
+            raise ValueError("window must be >= min_history")
+        self._lock = threading.Lock()
+        self.window = window
+        self.min_history = min_history
+        self.step_ratio = step_ratio
+        self.host_wait_ratio = host_wait_ratio
+        self.min_host_wait_s = min_host_wait_s
+        self._steps: deque[float] = deque(maxlen=window)
+        self._waits: deque[float] = deque(maxlen=window)
+        self.anomalies: deque[dict] = deque(maxlen=max_anomalies)
+
+    def observe(
+        self, step: int, step_s: float, host_wait_s: float = 0.0
+    ) -> dict | None:
+        with self._lock:
+            anomaly = None
+            if len(self._steps) >= self.min_history:
+                med = statistics.median(self._steps)
+                if med > 0 and step_s > self.step_ratio * med:
+                    anomaly = {
+                        "kind": "slow_step",
+                        "step": step,
+                        "step_s": step_s,
+                        "trailing_median_s": med,
+                        "ratio": step_s / med,
+                    }
+                elif (
+                    host_wait_s > self.min_host_wait_s
+                    and host_wait_s
+                    > self.host_wait_ratio
+                    * max(statistics.median(self._waits), self.min_host_wait_s)
+                ):
+                    anomaly = {
+                        "kind": "host_wait_regression",
+                        "step": step,
+                        "host_wait_s": host_wait_s,
+                        "trailing_median_s": statistics.median(self._waits),
+                    }
+            self._steps.append(step_s)
+            self._waits.append(host_wait_s)
+            if anomaly is not None:
+                self.anomalies.append(anomaly)
+            return anomaly
+
+    def summary(self) -> dict:
+        with self._lock:
+            kinds: dict[str, int] = {}
+            for a in self.anomalies:
+                kinds[a["kind"]] = kinds.get(a["kind"], 0) + 1
+            return {
+                "anomaly_counts": kinds,
+                "recent_anomalies": list(self.anomalies)[-8:],
+            }
+
+
+class StepTimeline:
+    """Per-step phase recorder feeding windowed series + the detector.
+
+    ``record_step`` is the single entry point the train loop calls once
+    per step with the durations it already measures (host_wait) plus the
+    step wall and dispatch times.  Reads (``summary``) are safe from any
+    thread — the beacon writer and the recording loop may interleave.
+    """
+
+    def __init__(
+        self,
+        detector: StragglerDetector | None = None,
+        history: int = 512,
+        max_window_s: float = 300.0,
+        clock=time.monotonic,
+    ):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.step_time = WindowedHistogram(
+            bounds=DEFAULT_STEP_BOUNDS, max_window_s=max_window_s, clock=clock
+        )
+        self.host_wait = WindowedHistogram(
+            bounds=DEFAULT_STEP_BOUNDS, max_window_s=max_window_s, clock=clock
+        )
+        self.dispatch = WindowedHistogram(
+            bounds=DEFAULT_STEP_BOUNDS, max_window_s=max_window_s, clock=clock
+        )
+        self.detector = detector or StragglerDetector()
+        self._recent: deque[tuple] = deque(maxlen=history)
+        self._last_step = -1
+
+    def record_step(
+        self,
+        step: int,
+        step_s: float,
+        host_wait_s: float = 0.0,
+        dispatch_s: float = 0.0,
+        now: float | None = None,
+    ) -> dict | None:
+        """Record one step; returns the detector's anomaly (if any)."""
+        now = self._clock() if now is None else now
+        self.step_time.observe(step_s, now)
+        self.host_wait.observe(host_wait_s, now)
+        self.dispatch.observe(dispatch_s, now)
+        with self._lock:
+            self._recent.append((step, step_s, host_wait_s, dispatch_s))
+            self._last_step = max(self._last_step, step)
+        return self.detector.observe(step, step_s, host_wait_s)
+
+    @property
+    def last_step(self) -> int:
+        with self._lock:
+            return self._last_step
+
+    def summary(self, window_s: float = 60.0, now: float | None = None) -> dict:
+        """The beacon body: windowed step/wait distributions + anomalies."""
+        now = self._clock() if now is None else now
+        step_w = self.step_time.window_summary(window_s, now)
+        wait_w = self.host_wait.window_summary(window_s, now)
+        return {
+            "last_step": self.last_step,
+            "window_s": window_s,
+            "steps_per_sec": step_w["rate"],
+            "step_s": {k: step_w[k] for k in ("count", "p50", "p90", "p99")},
+            "host_wait_s": {
+                k: wait_w[k] for k in ("count", "p50", "p90", "p99")
+            },
+            # Raw mergeable counts so the aggregator can compute fleet
+            # quantiles without re-observing anything.
+            "step_counts": self.step_time.window_counts(window_s, now),
+            "step_bounds": list(self.step_time.bounds),
+            **self.detector.summary(),
+        }
+
+
+class HostBeacon:
+    """One host's health file in the shared beacon directory.
+
+    ``write()`` snapshots the timeline summary and atomically replaces
+    ``<dir>/host_<id>.json`` — readers never see a torn file.  Call it
+    from a fit hook at the log cadence (cli/train.py --beacon-dir wires
+    exactly that).
+    """
+
+    def __init__(
+        self,
+        beacon_dir: str | Path,
+        host_id: int,
+        timeline: StepTimeline,
+        window_s: float = 60.0,
+    ):
+        self.dir = Path(beacon_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.host_id = int(host_id)
+        self.timeline = timeline
+        self.window_s = window_s
+        self.path = self.dir / f"host_{self.host_id}.json"
+
+    def summary(self) -> dict:
+        return {
+            "host": self.host_id,
+            "wall_time": time.time(),
+            **self.timeline.summary(self.window_s),
+        }
+
+    def write(self) -> Path:
+        tmp = self.path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(self.summary()))
+        os.replace(tmp, self.path)  # atomic on POSIX
+        return self.path
+
+
+def read_beacons(beacon_dir: str | Path) -> list[dict]:
+    """All parseable host beacons in the directory, sorted by host id."""
+    out = []
+    for p in sorted(Path(beacon_dir).glob("host_*.json")):
+        try:
+            out.append(json.loads(p.read_text()))
+        except (OSError, json.JSONDecodeError):
+            continue  # mid-replace or vanished: next poll sees it
+    return out
+
+
+def detect_fleet_stragglers(
+    beacons: list[dict], ratio: float = 2.0
+) -> list[int]:
+    """Host ids whose median step time exceeds ``ratio`` x the median of
+    the OTHER hosts' medians.
+
+    Cross-host-relative on purpose: a uniformly slow fleet (bigger model,
+    colder cache) flags nobody; one seeded-5x host flags alone even in a
+    2-host fleet (where a global median would be dragged halfway up by
+    the straggler itself).
+    """
+    meds = {
+        int(b["host"]): b["step_s"]["p50"]
+        for b in beacons
+        if b.get("step_s", {}).get("count", 0) > 0
+    }
+    if len(meds) < 2:
+        return []
+    flagged = []
+    for host, med in meds.items():
+        others = [m for h, m in meds.items() if h != host]
+        baseline = statistics.median(others)
+        if baseline > 0 and med > ratio * baseline:
+            flagged.append(host)
+    return sorted(flagged)
+
+
+def fleet_summary(beacons: list[dict], ratio: float = 2.0) -> dict:
+    """The aggregated fleet view: per-host digests + straggler verdict."""
+    stragglers = detect_fleet_stragglers(beacons, ratio)
+    hosts = []
+    for b in sorted(beacons, key=lambda x: x.get("host", -1)):
+        host = int(b.get("host", -1))
+        hosts.append({
+            "host": host,
+            "last_step": b.get("last_step"),
+            "median_step_s": b.get("step_s", {}).get("p50"),
+            "p99_step_s": b.get("step_s", {}).get("p99"),
+            "steps_per_sec": b.get("steps_per_sec"),
+            "anomaly_counts": b.get("anomaly_counts", {}),
+            "straggler": host in stragglers,
+        })
+    return {
+        "n_hosts": len(hosts),
+        "stragglers": stragglers,
+        "straggler_ratio": ratio,
+        "hosts": hosts,
+    }

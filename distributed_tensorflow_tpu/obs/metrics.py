@@ -15,9 +15,18 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections.abc import Sequence
 from pathlib import Path
 
 import jax
+
+from distributed_tensorflow_tpu.obs.timeseries import (
+    DEFAULT_LATENCY_BOUNDS,
+    DEFAULT_WINDOWS_S,
+    WindowedCounter,
+    WindowedHistogram,
+    WindowedHistogramFamily,
+)
 
 
 class JsonlWriter:
@@ -126,24 +135,33 @@ class Histogram:
             self.total = 0.0
             self.max = 0.0
 
-    def percentile(self, p: float) -> float:
-        """p in [0, 100] over the retained sample window (0.0 when empty)."""
-        with self._lock:
-            if not self._buf:
-                return 0.0
-            s = sorted(self._buf)
+    @staticmethod
+    def _pct(s: list[float], p: float) -> float:
+        """p in [0, 100] over an already-sorted sample list."""
+        if not s:
+            return 0.0
         k = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
         return s[k]
 
+    def percentile(self, p: float) -> float:
+        """p in [0, 100] over the retained sample window (0.0 when empty)."""
+        with self._lock:
+            s = sorted(self._buf)
+        return self._pct(s, p)
+
     def summary(self) -> dict:
+        # ONE lock acquisition and ONE sort: count/percentiles come from
+        # the same instant, so a /metrics scrape never mixes a newer count
+        # with older percentiles (and doesn't sort the buffer three times).
         with self._lock:
             count, total, mx = self.count, self.total, self.max
+            s = sorted(self._buf)
         return {
             "count": count,
             "mean": total / count if count else 0.0,
-            "p50": self.percentile(50),
-            "p90": self.percentile(90),
-            "p99": self.percentile(99),
+            "p50": self._pct(s, 50),
+            "p90": self._pct(s, 90),
+            "p99": self._pct(s, 99),
             "max": mx,
         }
 
@@ -220,6 +238,10 @@ class FeedMetrics:
         self.assembly = Histogram()        # s/batch of assembly + device put
         self.queue_depth = Gauge()         # prefetch queue occupancy
         self.batches_assembled = Counter()
+        # Time-aware twin of host_wait (obs/timeseries.py): trailing-window
+        # wait distribution, so a feed regression is visible while it
+        # happens (the fleet straggler detector reads it via StepTimeline).
+        self.host_wait_w = WindowedHistogram()
         self._lock = threading.Lock()
         self._win_wait = 0.0
         self._win_steps = 0
@@ -227,6 +249,7 @@ class FeedMetrics:
     def observe_wait(self, seconds: float) -> None:
         """Consumer-side: record one blocking wait for a batch."""
         self.host_wait.observe(seconds)
+        self.host_wait_w.observe(seconds)
         with self._lock:
             self._win_wait += float(seconds)
             self._win_steps += 1
@@ -259,9 +282,30 @@ class FeedMetrics:
 
 class ServeMetrics:
     """The serving subsystem's observability bundle (serve/batcher.py wires
-    it; serve/server.py exposes it as JSON at ``GET /metrics``)."""
+    it; serve/server.py exposes it as JSON at ``GET /metrics`` and as
+    Prometheus text at ``GET /metrics?format=prom`` via obs/export.py).
 
-    def __init__(self):
+    Two generations of families live side by side:
+
+    - **cumulative** (since boot): the original Counter/Gauge/Histogram
+      instruments — stable JSON keys, Prometheus counter/histogram
+      exposition;
+    - **windowed** (obs/timeseries.py): trailing-rate counters and
+      bucketed windowed histograms feeding the SLO burn-rate math and the
+      readiness probe.  ``windowed=False`` skips them (one bool check on
+      the hot path) — the A/B knob for the overhead measurement in
+      docs/PERF.md.
+
+    ``latency_bounds`` overrides the windowed latency bucket layout; pass
+    ``obs.timeseries.bounds_with(slo_threshold_s)`` so SLO attainment at
+    the threshold is exact (cli/serve.py and serve_bench do).
+    """
+
+    #: trailing windows surfaced in snapshots (short, mid, long)
+    WINDOWS_S = DEFAULT_WINDOWS_S
+
+    def __init__(self, windowed: bool = True, latency_bounds: tuple | None = None):
+        self.windowed = windowed
         self.latency = Histogram()          # seconds, submit -> reply
         self.batch_occupancy = Histogram()  # rows per flushed batch
         self.queue_depth = Gauge()
@@ -295,13 +339,66 @@ class ServeMetrics:
         # (queue full), "validation" (RequestError at submit),
         # "engine_failure" (batch raised mid-flight), "closed".
         self.rejected_by_cause = LabelledCounter()
+        # ------------------------------------------------ windowed families
+        # (obs/timeseries.py) — the SLO/health layer's inputs.  bad_w
+        # counts requests that burned availability budget (backpressure +
+        # engine failure + closed; NOT validation — that's the client's
+        # error); ok_w counts delivered results.  rejected_w is the
+        # backpressure-only series the saturation probe reads.
+        bounds = latency_bounds or DEFAULT_LATENCY_BOUNDS
+        self.latency_w = WindowedHistogram(bounds=bounds)
+        self.phase_w = WindowedHistogramFamily(bounds=bounds)
+        self.requests_w = WindowedCounter()   # accepted submissions
+        self.ok_w = WindowedCounter()         # delivered results
+        self.bad_w = WindowedCounter()        # budget-burning failures
+        self.rejected_w = WindowedCounter()   # backpressure sheds only
 
     def observe_phase(self, name: str, seconds: float, layout: str = "") -> None:
         """Record one per-request phase sample, double-keyed by the engine's
         mesh layout when one is known (serve/batcher.py passes it through)."""
         self.phase.observe(name, seconds)
+        if self.windowed:
+            self.phase_w.observe(name, seconds)
         if layout:
             self.layout_phase.observe(f"{layout}/{name}", seconds)
+
+    def observe_phase_batch(
+        self,
+        name: str,
+        values: Sequence[float],
+        layout: str = "",
+        now: float | None = None,
+    ) -> None:
+        """One flush's worth of samples for a single phase. The windowed
+        twin takes its lock ONCE for the whole batch (``observe_many``) —
+        per-sample locking would scale hot-path lock traffic with the
+        batch size (and trip the racetrace-overhead bound in tests)."""
+        for v in values:
+            self.phase.observe(name, v)
+            if layout:
+                self.layout_phase.observe(f"{layout}/{name}", v)
+        if self.windowed:
+            self.phase_w.observe_many(name, values, now)
+
+    def windowed_snapshot(self) -> dict:
+        """Per-window trailing rates + latency quantiles (ms), keyed
+        "10s"/"60s"/"300s" — the time-aware section of ``snapshot()``."""
+        out = {}
+        for w in self.WINDOWS_S:
+            lat = self.latency_w.window_summary(w)
+            out[f"{w:g}s"] = {
+                "request_rate": self.requests_w.rate(w),
+                "ok_rate": self.ok_w.rate(w),
+                "rejected_rate": self.rejected_w.rate(w),
+                "failure_rate": self.bad_w.rate(w),
+                "latency_ms": {
+                    "count": lat["count"],
+                    "p50": lat["p50"] * 1e3,
+                    "p90": lat["p90"] * 1e3,
+                    "p99": lat["p99"] * 1e3,
+                },
+            }
+        return out
 
     def snapshot(self) -> dict:
         lat = self.latency.summary()
@@ -337,6 +434,9 @@ class ServeMetrics:
                 }
                 for key, summ in self.layout_phase.snapshot().items()
             },
+            **(
+                {"windowed": self.windowed_snapshot()} if self.windowed else {}
+            ),
         }
 
 

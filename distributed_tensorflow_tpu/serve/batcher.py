@@ -164,13 +164,19 @@ class DynamicBatcher:
         key = self._bucket_for(payload) if self._bucket_for else None
         if request_id is None:
             request_id = f"r-{next(self._req_ids):08d}"
+        metrics = self.metrics  # local: instruments carry their own locks
         with self._cv:
             if self._closed:
-                self.metrics.rejected_by_cause.inc("closed")
+                metrics.rejected_by_cause.inc("closed")
+                if metrics.windowed:
+                    metrics.bad_w.add(1.0)
                 raise RuntimeError("batcher is closed")
             if self._count >= self.config.max_queue:
-                self.metrics.rejected.inc()
-                self.metrics.rejected_by_cause.inc("backpressure")
+                metrics.rejected.inc()
+                metrics.rejected_by_cause.inc("backpressure")
+                if metrics.windowed:
+                    metrics.rejected_w.add(1.0)
+                    metrics.bad_w.add(1.0)
                 self.tracer.instant(
                     "rejected", "serve", request_id=request_id,
                     cause="backpressure", queue_depth=self._count,
@@ -184,10 +190,24 @@ class DynamicBatcher:
             pending.future.request_id = request_id
             self._queues.setdefault(key, deque()).append(pending)
             self._count += 1
-            self.metrics.requests.inc()
-            self.metrics.queue_depth.set(self._count)
+            metrics.requests.inc()
+            metrics.queue_depth.set(self._count)
             self._cv.notify_all()
+        if metrics.windowed:
+            metrics.requests_w.add(1.0)
         return pending.future
+
+    def status(self) -> dict:
+        """Live stack view for the health tracker / probe body: one
+        consistent read of the state the flusher mutates under ``_cv``."""
+        with self._cv:
+            return {
+                "closed": self._closed,
+                "queue_depth": self._count,
+                "max_queue": self.config.max_queue,
+                "in_flight": self._n_inflight,
+                "max_in_flight": self.config.max_in_flight,
+            }
 
     # ------------------------------------------------------------- flusher
 
@@ -253,8 +273,11 @@ class DynamicBatcher:
             return batch
 
     def _fail(self, batch: list[_Pending], exc: BaseException) -> None:
-        self.metrics.errors.inc()
-        self.metrics.rejected_by_cause.inc("engine_failure", len(batch))
+        metrics = self.metrics  # local: instruments carry their own locks
+        metrics.errors.inc()
+        metrics.rejected_by_cause.inc("engine_failure", len(batch))
+        if metrics.windowed:
+            metrics.bad_w.add(float(len(batch)))
         for p in batch:
             self.tracer.instant(
                 "engine_failure", "serve", request_id=p.request_id,
@@ -308,9 +331,17 @@ class DynamicBatcher:
                 t = t_end
             tracer.record(final_phase, t, now, cat="serve",
                           args={"rows": len(batch)})
-        for p, r in zip(batch, results):
+        windowed = metrics.windowed
+        latencies: list[float] = []
+        phase_values: dict[str, list[float]] = {}
+        per_request: list[dict] = []
+        for p in batch:
             latency = now - p.t_enqueue
-            self.metrics.latency.observe(latency)
+            metrics.latency.observe(latency)
+            latencies.append(latency)
+            # Exact per-request latency for the serve_bench SLO-math gate
+            # (windowed-histogram attainment vs the exact log).
+            p.future.latency_s = latency
             phases = {"queue_wait": p.t_taken - p.t_enqueue}
             t = p.t_taken
             for name, t_end in marks:
@@ -318,11 +349,21 @@ class DynamicBatcher:
                 t = t_end
             phases[final_phase] = now - t
             for name, dt in phases.items():
-                metrics.observe_phase(name, dt, layout)
+                phase_values.setdefault(name, []).append(dt)
+            per_request.append(phases)
             tracer.record("request", p.t_enqueue, now, cat="serve",
                           request_id=p.request_id)
             tracer.record("queue_wait", p.t_enqueue, p.t_taken, cat="serve",
                           request_id=p.request_id)
+        # Whole-batch metric recording BEFORE resolving futures (a reader
+        # joining on a future must see its batch's samples), with the
+        # windowed series taking each lock once per flush, not per request.
+        for name, vals in phase_values.items():
+            metrics.observe_phase_batch(name, vals, layout, now)
+        if windowed:
+            metrics.latency_w.observe_many(latencies, now)
+            metrics.ok_w.add(float(len(batch)), now)
+        for p, r, phases in zip(batch, results, per_request):
             if not p.future.cancelled():
                 p.future.phases = phases
                 p.future.set_result(r)

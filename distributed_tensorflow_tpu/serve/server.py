@@ -8,8 +8,15 @@ them — exactly the concurrency the micro-batcher exists to exploit).
 
 Routes::
 
-    GET  /healthz    -> {"status": "ok", "engine": ...}
-    GET  /metrics    -> ServeMetrics.snapshot() as JSON
+    GET  /healthz    -> readiness probe: 200 {"status": "ready", ...} only
+                        when the stack serves; 503 with the state string
+                        (starting/degraded/draining/closed) otherwise —
+                        the router contract in docs/DEPLOY.md
+    GET  /metrics    -> ServeMetrics.snapshot() as JSON;
+                        ``?format=prom`` -> Prometheus text exposition
+                        (format 0.0.4) of every family + SLO + health
+    GET  /sloz       -> declared SLOs: per-window attainment, error-budget
+                        burn rates, ok/warn/page verdicts
     GET  /statusz    -> live status: queue depths, in-flight batches,
                         tier/bucket occupancy, rejections by cause,
                         recent-span summary
@@ -17,6 +24,9 @@ Routes::
                         trace-event JSON (Perfetto / chrome://tracing)
     POST /profilez?ms=N -> capture a bounded jax.profiler window on the
                         RUNNING server (needs trace_dir)
+    POST /drainz     -> flip to draining: /healthz goes 503 so the router
+                        stops routing here, while in-flight + already-
+                        queued requests still complete
     POST /v1/mlm     -> BERT: pred_ids / score / nsp_probs for one example
     POST /v1/embed   -> BERT: pooled [CLS] embedding for one example
     POST /v1/classify-> image: top-k ids/probs for one example
@@ -43,7 +53,14 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from distributed_tensorflow_tpu.obs.export import (
+    PROM_CONTENT_TYPE,
+    prometheus_text,
+)
+from distributed_tensorflow_tpu.obs.health import HealthTracker
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.obs.slo import SloSpec, SloTracker
+from distributed_tensorflow_tpu.obs.timeseries import bounds_with
 from distributed_tensorflow_tpu.obs.trace import Tracer
 from distributed_tensorflow_tpu.serve.batcher import (
     BatcherConfig,
@@ -71,9 +88,15 @@ class Client:
         config: BatcherConfig | None = None,
         metrics: ServeMetrics | None = None,
         tracer: Tracer | None = None,
+        slo: SloSpec | None = None,
     ):
         self.engine = engine
-        self.metrics = metrics or ServeMetrics()
+        if metrics is None:
+            # Insert the SLO latency threshold as an explicit histogram
+            # bound so windowed attainment at the threshold is EXACT.
+            threshold_s = (slo.latency_threshold_ms / 1e3) if slo else 0.0
+            metrics = ServeMetrics(latency_bounds=bounds_with(threshold_s))
+        self.metrics = metrics
         self.tracer = tracer if tracer is not None else Tracer()
         if config is None:
             config = BatcherConfig(max_batch=engine.max_batch)
@@ -107,6 +130,15 @@ class Client:
             tracer=self.tracer,
             layout=getattr(engine, "layout", ""),
         )
+        # SLO + readiness: the tracker reads the windowed families and the
+        # batcher's live status at probe time — no thread, nothing to join.
+        self.slo = SloTracker(self.metrics, slo or SloSpec())
+        self.health = HealthTracker(
+            status_fn=self.batcher.status,
+            metrics=self.metrics if self.metrics.windowed else None,
+            slo=self.slo if self.slo.spec.enabled else None,
+        )
+        self.health.mark_ready()  # batcher threads are up; we can serve
 
     def submit(self, payload: dict, request_id: str | None = None) -> Future:
         try:
@@ -123,7 +155,18 @@ class Client:
     def call(self, payload: dict, timeout: float | None = 60.0) -> dict:
         return self.submit(payload).result(timeout=timeout)
 
+    def start_draining(self) -> None:
+        """Flip /healthz to 503 (state ``draining``) WITHOUT closing: the
+        router stops sending traffic while queued work still completes.
+        Idempotent from ready/starting; a no-op once already draining."""
+        if self.health.lifecycle in ("starting", "ready"):
+            try:
+                self.health.mark_draining()
+            except ValueError:
+                pass  # concurrent drain/close won the transition race
+
     def close(self) -> None:
+        self.health.mark_closed()
         self.batcher.close()
 
     def __enter__(self):
@@ -184,6 +227,14 @@ def build_http_server(
             self.end_headers()
             self.wfile.write(data)
 
+        def _reply_text(self, code: int, text: str, content_type: str):
+            data = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def _statusz(self) -> dict:
             snap = client.metrics.snapshot()
             tracer = client.tracer
@@ -209,12 +260,32 @@ def build_http_server(
         def do_GET(self):
             url = urlparse(self.path)
             if url.path == "/healthz":
-                self._reply(
-                    200,
-                    {"status": "ok", "engine": type(client.engine).__name__},
-                )
+                code, body = client.health.probe()
+                body["engine"] = type(client.engine).__name__
+                self._reply(code, body)
             elif url.path == "/metrics":
-                self._reply(200, client.metrics.snapshot())
+                q = parse_qs(url.query)
+                if q.get("format", [""])[0] == "prom":
+                    self._reply_text(
+                        200,
+                        prometheus_text(
+                            client.metrics,
+                            slo=(
+                                client.slo
+                                if client.slo.spec.enabled
+                                else None
+                            ),
+                            health=client.health,
+                        ),
+                        PROM_CONTENT_TYPE,
+                    )
+                else:
+                    self._reply(200, client.metrics.snapshot())
+            elif url.path == "/sloz":
+                state, _ = client.health.state()
+                self._reply(
+                    200, {"health": state, **client.slo.report()}
+                )
             elif url.path == "/statusz":
                 self._reply(200, self._statusz())
             elif url.path == "/tracez":
@@ -254,6 +325,11 @@ def build_http_server(
             url = urlparse(self.path)
             if url.path == "/profilez":
                 self._profilez(url)
+                return
+            if url.path == "/drainz":
+                client.start_draining()
+                code, body = client.health.probe()
+                self._reply(200, {"draining": True, **body})
                 return
             fields = self._routes.get(url.path)
             if fields is None:

@@ -54,6 +54,7 @@ def fit(
     eval_every: int = 0,
     feed_metrics: FeedMetrics | None = None,
     tracer: Tracer | None = None,
+    timeline=None,
 ):
     """Run the training loop; returns the final state.
 
@@ -80,6 +81,12 @@ def fit(
     ``checkpoint_save`` and ``eval`` spans — each carrying its ``step``
     correlation key. Disabled (the default) it is a no-op context manager
     per call site, cheap enough to leave in the hot loop.
+
+    ``timeline`` (obs/fleet.py :class:`StepTimeline`) records every step's
+    wall / host-wait / dispatch durations into windowed series and runs the
+    in-line straggler detector — the per-host health view the fleet
+    beacons publish (cli/train.py ``--beacon-dir``). Three clock reads and
+    a histogram insert per step; ``None`` (the default) costs nothing.
     """
     if rng is None:
         rng = jax.random.key(0)
@@ -99,8 +106,11 @@ def fit(
         batch = next(it)
     feed_metrics.observe_wait(time.perf_counter() - t_fetch)
     for step in range(start_step, num_steps):
+        t_iter = time.perf_counter()
+        wait_s = 0.0
         with tracer.span("dispatch", "train", step=step):
             state, metrics = train_step(state, batch, rng)
+        dispatch_s = time.perf_counter() - t_iter
         if t_steady is None:
             # The first call paid tracing + compilation (dispatch itself is
             # async); everything after this point is the steady-state
@@ -111,7 +121,8 @@ def fit(
             t_fetch = time.perf_counter()
             with tracer.span("host_wait", "train", step=step + 1):
                 batch = next(it)
-            feed_metrics.observe_wait(time.perf_counter() - t_fetch)
+            wait_s = time.perf_counter() - t_fetch
+            feed_metrics.observe_wait(wait_s)
         if log_every and ((step + 1) % log_every == 0 or step + 1 == num_steps):
             # Fetch (blocks on the step stream only here) — ONE device_get
             # for the whole dict, not a per-leaf float() sync each. The
@@ -161,4 +172,15 @@ def fit(
         if checkpointer is not None and ckpt_every and (step + 1) % ckpt_every == 0:
             with tracer.span("checkpoint_save", "train", step=step + 1):
                 checkpointer.save(step + 1, state)
+        if timeline is not None:
+            # Whole-iteration wall time on purpose: a step slowed by eval
+            # or a checkpoint save IS slow from the fleet's point of view;
+            # the detector's trailing MEDIAN keeps periodic spikes from
+            # shifting the baseline.
+            timeline.record_step(
+                step + 1,
+                time.perf_counter() - t_iter,
+                host_wait_s=wait_s,
+                dispatch_s=dispatch_s,
+            )
     return state, pending_metrics

@@ -90,6 +90,9 @@ def _build_model(args, *, pipeline_parallel: int = 1):
 
 
 def build_client(args):
+    from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+    from distributed_tensorflow_tpu.obs.slo import SloSpec
+    from distributed_tensorflow_tpu.obs.timeseries import bounds_with
     from distributed_tensorflow_tpu.obs.trace import Tracer
     from distributed_tensorflow_tpu.serve import (
         BatcherConfig,
@@ -120,6 +123,19 @@ def build_client(args):
     # Tracing on iff --trace-dir: the same run then doubles as the
     # enabled-vs-disabled overhead measurement (docs/PERF.md).
     tracing = bool(args.trace_dir)
+    slo = SloSpec(
+        latency_threshold_ms=args.slo_p99_ms,
+        latency_target=args.slo_target,
+        availability_target=args.slo_availability,
+    )
+    # --no-windowed: the A/B knob for the windowed-metrics overhead
+    # measurement (docs/PERF.md) — same run, windowed hot-path observes off.
+    metrics = None
+    if args.no_windowed:
+        metrics = ServeMetrics(
+            windowed=False,
+            latency_bounds=bounds_with(args.slo_p99_ms / 1e3),
+        )
     client = Client(
         engine,
         BatcherConfig(
@@ -129,7 +145,9 @@ def build_client(args):
             max_in_flight=args.max_in_flight,
             bucket_queues=args.bucket_queues,
         ),
+        metrics=metrics,
         tracer=Tracer(buffer_size=args.trace_buffer, enabled=tracing),
+        slo=slo,
     )
     return client, cfg.vocab_size
 
@@ -185,6 +203,12 @@ def run_load(client, payloads, offered_rps: float, duration_s: float) -> dict:
         f.result(timeout=120)
     t_end = time.monotonic()
     served = len(futures)
+    # Exact per-request latency log (stamped by the batcher at delivery):
+    # the ground truth the windowed-histogram SLO math is checked against.
+    exact = [
+        f.latency_s for _, f in futures
+        if getattr(f, "latency_s", None) is not None
+    ]
     return {
         "offered_rps": offered_rps,
         "submitted": n,
@@ -192,6 +216,7 @@ def run_load(client, payloads, offered_rps: float, duration_s: float) -> dict:
         "rejected": rejected,
         "achieved_rps": served / (t_end - t0),
         "wall_s": t_end - t0,
+        "_exact_latency_s": exact,
     }
 
 
@@ -425,6 +450,17 @@ def main(argv=None) -> int:
                    "baseline)")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="MoE expert count for epN layouts (0 = dense FFN)")
+    p.add_argument("--slo-p99-ms", type=float, default=50.0,
+                   help="latency SLO threshold (ms) for the SLO section "
+                   "and the --quick SLO-math consistency gate")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="latency SLO target fraction")
+    p.add_argument("--slo-availability", type=float, default=0.0,
+                   help="availability SLO target (0 = disabled)")
+    p.add_argument("--no-windowed", action="store_true",
+                   help="disable the windowed metric families (the A/B "
+                   "baseline for the windowed-overhead measurement; "
+                   "docs/PERF.md)")
     p.add_argument("--ckpt-dir", default="",
                    help="serve a real checkpoint instead of random init")
     p.add_argument("--trace-dir", default="",
@@ -471,10 +507,12 @@ def main(argv=None) -> int:
                 f"# single-stream (occupancy-1): {single['rps']:.1f} req/s "
                 f"over {single['served']} requests"
             )
+        threshold_s = args.slo_p99_ms / 1e3
         for rps in args.loads:
             # Per-point metrics: fresh histograms so p99 is per-load;
             # counters diff across the point (they are cumulative).
             metrics.latency.reset()
+            metrics.latency_w.reset()
             metrics.batch_occupancy.reset()
             metrics.tier_hits.reset()
             metrics.bucket_hits.reset()
@@ -482,7 +520,32 @@ def main(argv=None) -> int:
             padded0 = metrics.padded_rows.value
             batches0 = metrics.batches.value
             r = run_load(client, payloads, rps, args.duration)
+            exact = r.pop("_exact_latency_s")
             snap = metrics.snapshot()
+            # SLO math consistency: windowed-bucketed attainment (threshold
+            # inserted as an explicit bound) vs the exact per-request log.
+            # window=None = everything since the per-point reset.
+            r["slo_threshold_ms"] = args.slo_p99_ms
+            r["slo_attainment_exact"] = (
+                sum(1 for v in exact if v <= threshold_s) / len(exact)
+                if exact else 1.0
+            )
+            r["slo_attainment_windowed"] = metrics.latency_w.attainment(
+                threshold_s, None
+            )
+            r["slo_attainment_gap"] = abs(
+                r["slo_attainment_windowed"] - r["slo_attainment_exact"]
+            )
+            slo_rep = client.slo.report()
+            r["slo_windows"] = {
+                s["name"]: {
+                    w: {"attainment": row["attainment"],
+                        "burn_rate": row["burn_rate"]}
+                    for w, row in s["windows"].items()
+                }
+                for s in slo_rep["slos"]
+            }
+            r["slo_verdict"] = slo_rep["verdict"]
             r["p50_ms"] = snap["latency_ms"]["p50"]
             r["p99_ms"] = snap["latency_ms"]["p99"]
             r["mean_ms"] = snap["latency_ms"]["mean"]
@@ -550,6 +613,31 @@ def main(argv=None) -> int:
             )
     report["max_phase_divergence"] = max_divergence
 
+    # ---------------------------------------------------- SLO section
+    # Attainment against the declared latency SLO per load point, from the
+    # windowed bucketed histogram, CHECKED against the exact per-request
+    # log (the two must agree: the threshold is an explicit bucket bound).
+    # Burn rates are the multi-window error-budget view a pager would see.
+    max_slo_gap = 0.0
+    print(
+        f"\nSLO section (latency threshold {args.slo_p99_ms:g} ms, "
+        f"target {args.slo_target:g}):"
+    )
+    for r in rows:
+        max_slo_gap = max(max_slo_gap, r["slo_attainment_gap"])
+        print(
+            f"  offered {r['offered_rps']:.0f} rps — attainment "
+            f"{100 * r['slo_attainment_windowed']:.2f}% windowed / "
+            f"{100 * r['slo_attainment_exact']:.2f}% exact "
+            f"(gap {r['slo_attainment_gap']:.4f}), verdict {r['slo_verdict']}"
+        )
+        for name, windows in r["slo_windows"].items():
+            burns = " ".join(
+                f"{w}={row['burn_rate']:.2f}" for w, row in windows.items()
+            )
+            print(f"    {name} burn rate: {burns}")
+    report["max_slo_attainment_gap"] = max_slo_gap
+
     if args.trace_dir:
         trace_path = os.path.join(args.trace_dir, "serve_bench_trace.json")
         client.tracer.export(trace_path)
@@ -564,6 +652,15 @@ def main(argv=None) -> int:
             f"FAIL: traced phase sum diverges {100 * max_divergence:.1f}% "
             "from measured wall latency (>25%) — span instrumentation has "
             "drifted from the enqueue->reply timestamps",
+            file=sys.stderr,
+        )
+        return 1
+    if args.quick and not args.no_windowed and max_slo_gap > 0.02:
+        print(
+            f"FAIL: windowed-histogram SLO attainment diverges "
+            f"{max_slo_gap:.4f} (>0.02) from the exact per-request log — "
+            "the SLO math has drifted (threshold no longer an exact bucket "
+            "bound, or the windowed observe path lost samples)",
             file=sys.stderr,
         )
         return 1

@@ -30,7 +30,15 @@ def main() -> int:
     # threefry_partitionable matches conftest so the pp/ep rehearsals'
     # trajectories are comparable against the launcher's in-process runs.
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    try:
+        jax.config.update("jax_num_cpu_devices", 4)
+    except AttributeError:  # jax < 0.5: same fallback as tests/conftest.py
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        ).strip()
     jax.config.update("jax_threefry_partitionable", True)
 
     import jax.numpy as jnp
@@ -52,6 +60,15 @@ def main() -> int:
         make_classification_loss,
     )
     from distributed_tensorflow_tpu.train.step import place_state
+
+    if mode == "straggler":
+        # Beacons are collective-free by design — the processes share only
+        # the beacon directory, never a JAX cluster — so this mode skips
+        # initialize_runtime and runs each host on its own local mesh. It
+        # keeps working where the CPU backend can't form a cross-process
+        # cluster (jax < 0.5: "Multiprocess computations aren't
+        # implemented on the CPU backend").
+        return _straggler_body(proc_id, sys.argv[5])
 
     initialize_runtime(
         coordinator_address=f"127.0.0.1:{port}",
@@ -104,6 +121,84 @@ def main() -> int:
                 "proc": proc_id,
                 "digest": round(digest, 6),
                 "loss": loss,
+                "step": int(state.step),
+                "n_devices": len(jax.devices()),
+            }
+        )
+    )
+    return 0
+
+
+def _straggler_body(proc_id: int, beacon_dir: str) -> int:
+    """Fleet-health rehearsal: the sync-DP LeNet run driven through the
+    real ``fit(timeline=...)`` path, with process 0 seeded 5x slower (a
+    per-step sleep — the 'one bad host' failure mode). Each process trains
+    on its own local-device mesh (no cross-process cluster: beacons are
+    the coordination-free channel under test) and writes its HostBeacon;
+    the launcher aggregates the beacon directory and must flag process 0
+    and ONLY process 0."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.data import (
+        device_batches,
+        synthetic_image_classification,
+    )
+    from distributed_tensorflow_tpu.models import LeNet5
+    from distributed_tensorflow_tpu.obs.fleet import HostBeacon, StepTimeline
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.train import (
+        create_train_state,
+        make_train_step,
+    )
+    from distributed_tensorflow_tpu.train.loop import fit
+    from distributed_tensorflow_tpu.train.objectives import (
+        init_model,
+        make_classification_loss,
+    )
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    mesh = build_mesh({"data": -1})
+    model = LeNet5()
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1), jnp.float32)
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    state = place_state(create_train_state(params, tx, model_state), mesh)
+    step = make_train_step(make_classification_loss(model), tx, mesh)
+
+    # 5x seeded degradation, sized to dominate the ~30ms real step compute
+    # (0.05 vs 0.01 would land the beacon medians right at the 2.0 detection
+    # threshold once compute is added on top).
+    delay = 0.25 if proc_id == 0 else 0.05
+
+    def seeded_step(state_, batch_, rng_):
+        time.sleep(delay)  # the seeded degradation (sync-DP keeps lockstep)
+        return step(state_, batch_, rng_)
+
+    timeline = StepTimeline()
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+    batches = device_batches(ds, mesh, global_batch=32, seed=1)
+    state, _ = fit(
+        state,
+        seeded_step,
+        batches,
+        num_steps=12,
+        log_every=0,
+        timeline=timeline,
+    )
+    beacon = HostBeacon(beacon_dir, proc_id, timeline)
+    beacon.write()
+    summ = timeline.summary()
+    print(
+        json.dumps(
+            {
+                "proc": proc_id,
+                "last_step": timeline.last_step,
+                "median_step_s": summ["step_s"]["p50"],
                 "step": int(state.step),
                 "n_devices": len(jax.devices()),
             }

@@ -1,0 +1,237 @@
+"""Fleet health (obs/fleet.py): straggler detection on seeded traces,
+step timelines, beacon write/read/aggregate, fit() integration."""
+
+import json
+
+import pytest
+
+from distributed_tensorflow_tpu.obs.fleet import (
+    HostBeacon,
+    StepTimeline,
+    StragglerDetector,
+    detect_fleet_stragglers,
+    fleet_summary,
+    read_beacons,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -------------------------------------------------------- StragglerDetector
+
+
+def test_detector_needs_history_before_flagging():
+    d = StragglerDetector(window=16, min_history=8)
+    # A 100x outlier in the warm-up phase must NOT flag: no baseline yet.
+    for i in range(8):
+        assert d.observe(i, 1.0 if i == 3 else 0.01) is None
+
+
+def test_detector_flags_seeded_slow_step_only():
+    """Uniform 10ms trace with one 5x spike: exactly that step flags, and
+    the spike must not shift the trailing-median baseline afterwards."""
+    d = StragglerDetector(window=16, min_history=8)
+    anomalies = []
+    for i in range(40):
+        step_s = 0.05 if i == 20 else 0.01
+        a = d.observe(i, step_s)
+        if a is not None:
+            anomalies.append(a)
+    (a,) = anomalies
+    assert a["kind"] == "slow_step"
+    assert a["step"] == 20
+    assert a["ratio"] == pytest.approx(5.0)
+    assert a["trailing_median_s"] == pytest.approx(0.01)
+    assert d.summary()["anomaly_counts"] == {"slow_step": 1}
+
+
+def test_detector_median_baseline_resists_periodic_spikes():
+    """Every-8th-step checkpoint-like 3.5x spikes: each flags, but the
+    MEDIAN baseline stays at the common step time (a mean would drift up
+    and start missing them)."""
+    d = StragglerDetector(window=16, min_history=8)
+    flagged = [
+        i
+        for i in range(64)
+        if d.observe(i, 0.04 if (i % 8 == 7 and i > 8) else 0.01) is not None
+    ]
+    assert flagged == [15, 23, 31, 39, 47, 55, 63]
+
+
+def test_detector_host_wait_regression_and_floor():
+    d = StragglerDetector(window=16, min_history=8, min_host_wait_s=0.005)
+    # Microsecond jitter on an idle feed: under the absolute floor, never
+    # flags no matter the ratio.
+    for i in range(20):
+        assert d.observe(i, 0.01, host_wait_s=2e-4 if i % 2 else 1e-6) is None
+    # A real feed stall over both the floor and ratio x trailing median.
+    a = d.observe(20, 0.01, host_wait_s=0.5)
+    assert a is not None
+    assert a["kind"] == "host_wait_regression"
+    assert a["host_wait_s"] == 0.5
+    assert d.summary()["anomaly_counts"] == {"host_wait_regression": 1}
+
+
+def test_detector_window_validation():
+    with pytest.raises(ValueError, match="window must be >= min_history"):
+        StragglerDetector(window=4, min_history=8)
+
+
+# ------------------------------------------------------------- StepTimeline
+
+
+def test_timeline_records_and_summarises():
+    clk = FakeClock()
+    tl = StepTimeline(clock=clk)
+    for i in range(10):
+        clk.t += 0.02
+        assert tl.record_step(i + 1, 0.02, host_wait_s=0.001,
+                              dispatch_s=0.0005) is None
+    assert tl.last_step == 10
+    s = tl.summary(window_s=60.0)
+    assert s["last_step"] == 10
+    assert s["step_s"]["count"] == 10
+    assert s["host_wait_s"]["count"] == 10
+    assert 0.01 < s["step_s"]["p50"] <= 0.025  # containing bucket
+    assert s["steps_per_sec"] == pytest.approx(10 / 60.0)
+    # Mergeable raw counts ride along for fleet-level aggregation.
+    assert sum(s["step_counts"]) == 10
+    assert len(s["step_bounds"]) + 1 == len(s["step_counts"])
+    assert s["anomaly_counts"] == {}
+
+
+def test_timeline_surfaces_detector_anomaly():
+    clk = FakeClock()
+    tl = StepTimeline(StragglerDetector(window=16, min_history=4), clock=clk)
+    for i in range(8):
+        tl.record_step(i + 1, 0.01)
+    a = tl.record_step(9, 0.2)
+    assert a is not None and a["kind"] == "slow_step"
+    assert tl.summary()["recent_anomalies"][-1]["step"] == 9
+
+
+# ------------------------------------------------------- beacons + fleet view
+
+
+def _beacon(host, p50, count=50, last_step=100):
+    return {
+        "host": host,
+        "last_step": last_step,
+        "steps_per_sec": 1.0 / p50 if p50 else 0.0,
+        "step_s": {"count": count, "p50": p50, "p90": p50, "p99": p50},
+        "anomaly_counts": {},
+    }
+
+
+def test_fleet_straggler_flags_only_the_slow_host():
+    beacons = [_beacon(0, 0.25), _beacon(1, 0.01), _beacon(2, 0.012)]
+    assert detect_fleet_stragglers(beacons, ratio=2.0) == [0]
+
+
+def test_uniformly_slow_fleet_flags_nobody():
+    # Everyone 10x slower than "normal": relative detection stays quiet.
+    beacons = [_beacon(h, 0.1) for h in range(4)]
+    assert detect_fleet_stragglers(beacons, ratio=2.0) == []
+
+
+def test_two_host_fleet_uses_other_host_as_baseline():
+    # With a global median the 5x host would drag the baseline halfway up;
+    # other-hosts-only keeps the contrast sharp even at n=2.
+    beacons = [_beacon(0, 0.05), _beacon(1, 0.01)]
+    assert detect_fleet_stragglers(beacons, ratio=2.0) == [0]
+
+
+def test_fleet_straggler_edge_cases():
+    assert detect_fleet_stragglers([], ratio=2.0) == []
+    assert detect_fleet_stragglers([_beacon(0, 0.5)], ratio=2.0) == []
+    # Hosts with no steps yet are excluded from the baseline.
+    beacons = [_beacon(0, 0.0, count=0), _beacon(1, 0.01)]
+    assert detect_fleet_stragglers(beacons, ratio=2.0) == []
+    # Strictly-greater comparison: exactly ratio x baseline does not flag.
+    beacons = [_beacon(0, 0.02), _beacon(1, 0.01), _beacon(2, 0.01)]
+    assert detect_fleet_stragglers(beacons, ratio=2.0) == []
+
+
+def test_fleet_summary_shape():
+    beacons = [_beacon(0, 0.25, last_step=90), _beacon(1, 0.01)]
+    view = fleet_summary(beacons, ratio=2.0)
+    assert view["n_hosts"] == 2
+    assert view["stragglers"] == [0]
+    flags = {h["host"]: h["straggler"] for h in view["hosts"]}
+    assert flags == {0: True, 1: False}
+    assert view["hosts"][0]["last_step"] == 90
+    assert view["hosts"][0]["median_step_s"] == 0.25
+
+
+def test_beacon_write_read_roundtrip(tmp_path):
+    clk = FakeClock()
+    fast, slow = StepTimeline(clock=clk), StepTimeline(clock=clk)
+    for i in range(20):
+        clk.t += 0.01
+        fast.record_step(i + 1, 0.005)
+        slow.record_step(i + 1, 0.25)
+    HostBeacon(tmp_path, 0, fast).write()
+    HostBeacon(tmp_path, 1, slow).write()
+    (tmp_path / "host_zz.json").write_text("{not json")  # torn write
+    beacons = read_beacons(tmp_path)
+    assert [b["host"] for b in beacons] == [0, 1]
+    assert all(b["last_step"] == 20 for b in beacons)
+    assert detect_fleet_stragglers(beacons, ratio=2.0) == [1]
+    assert fleet_summary(beacons)["stragglers"] == [1]
+
+
+def test_beacon_write_is_atomic_replace(tmp_path):
+    tl = StepTimeline(clock=FakeClock())
+    tl.record_step(1, 0.01)
+    b = HostBeacon(tmp_path, 3, tl)
+    p1 = b.write()
+    tl.record_step(2, 0.01)
+    p2 = b.write()
+    assert p1 == p2 == tmp_path / "host_3.json"
+    assert not list(tmp_path.glob("*.tmp"))  # no torn temp files left
+    assert json.loads(p1.read_text())["last_step"] == 2
+
+
+# ----------------------------------------------------------- fit integration
+
+
+def test_fit_records_timeline(monkeypatch):
+    """The real train loop feeds the timeline: every step lands, the
+    pull-ahead wait is recorded, and a seeded slow step is flagged by the
+    in-line detector."""
+    import itertools
+    import time as _time
+
+    from distributed_tensorflow_tpu.train.loop import fit
+
+    class _State:
+        step = 0
+
+    calls = {"n": 0}
+
+    def train_step(state, batch, rng):
+        i = calls["n"]
+        calls["n"] += 1
+        _time.sleep(0.05 if i == 10 else 0.002)
+        return state, {}
+
+    tl = StepTimeline(StragglerDetector(window=16, min_history=8))
+    out_state, _ = fit(
+        _State(), train_step, itertools.repeat({"x": 1}),
+        num_steps=12, log_every=0, timeline=tl,
+    )
+    assert tl.last_step == 12
+    assert tl.step_time.window_count(None) == 12
+    assert tl.host_wait.window_count(None) == 12
+    summ = tl.detector.summary()
+    assert summ["anomaly_counts"].get("slow_step") == 1
+    (anomaly,) = [a for a in summ["recent_anomalies"]
+                  if a["kind"] == "slow_step"]
+    assert anomaly["step"] == 11  # the seeded 25x step, 1-indexed
+    assert anomaly["ratio"] > 3.0

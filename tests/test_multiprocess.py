@@ -258,6 +258,57 @@ def test_two_process_ulysses_sequence_parallel_localhost():
     _assert_cluster_matches_reference("sp_ulysses")
 
 
+def test_two_process_straggler_detection_localhost(tmp_path):
+    """Fleet straggler detection on the real 2-process harness (ISSUE 10):
+    both workers run the identical sync-DP body through
+    ``fit(timeline=...)``, but process 0 is seeded 5x slower per step. Each
+    writes its HostBeacon into a shared directory; aggregating the beacons
+    must flag host 0 and ONLY host 0."""
+    from distributed_tensorflow_tpu.obs.fleet import (
+        detect_fleet_stragglers,
+        fleet_summary,
+        read_beacons,
+    )
+
+    beacon_dir = tmp_path / "beacons"
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(_REPO)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_REPO / "tests" / "_mp_worker.py"),
+             str(i), "2", str(port), "straggler", str(beacon_dir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=str(_REPO),
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert {o["proc"] for o in outs} == {0, 1}
+    for o in outs:
+        assert o["last_step"] == 12
+
+    beacons = read_beacons(beacon_dir)
+    assert {b["host"] for b in beacons} == {0, 1}
+    # The seeded host — and only it — must be flagged.
+    assert detect_fleet_stragglers(beacons, ratio=2.0) == [0]
+    summary = fleet_summary(beacons, ratio=2.0)
+    assert summary["stragglers"] == [0]
+    flags = {h["host"]: h["straggler"] for h in summary["hosts"]}
+    assert flags == {0: True, 1: False}
+
+
 def test_two_process_expert_parallel_localhost():
     """Cross-process EXPERT parallelism (VERDICT r4 #3): token-sharded
     GShard MoE on mesh {expert: 8} — the dispatch all_to_all routes

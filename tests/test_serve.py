@@ -218,7 +218,7 @@ def test_http_health_metrics_and_mlm(http_server):
     base, _ = http_server
     with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
         assert r.status == 200
-        assert json.loads(r.read())["status"] == "ok"
+        assert json.loads(r.read())["status"] == "ready"
 
     status, body = _post(base + "/v1/mlm", {"input_ids": [3, 5, 7]})
     assert status == 200

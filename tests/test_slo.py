@@ -1,0 +1,165 @@
+"""SLO burn-rate math (obs/slo.py): hand-computed verdicts on synthetic
+bursty traces driven by an injected clock."""
+
+import pytest
+
+from distributed_tensorflow_tpu.obs.slo import (
+    SloSpec,
+    SloTracker,
+    burn_rate,
+    worst,
+)
+from distributed_tensorflow_tpu.obs.timeseries import (
+    WindowedCounter,
+    WindowedHistogram,
+    bounds_with,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class FakeMetrics:
+    """The three windowed series SloTracker reads, on a fake clock."""
+
+    def __init__(self, clk, threshold_s: float):
+        self.latency_w = WindowedHistogram(
+            bounds=bounds_with(threshold_s), clock=clk
+        )
+        self.ok_w = WindowedCounter(clock=clk)
+        self.bad_w = WindowedCounter(clock=clk)
+
+
+SPEC = SloSpec(latency_threshold_ms=50.0, latency_target=0.9)
+
+
+def _tracker(clk, spec=SPEC):
+    return SloTracker(FakeMetrics(clk, 0.05), spec, clock=clk), None
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SloSpec(latency_threshold_ms=-1.0)
+    with pytest.raises(ValueError):
+        SloSpec(latency_target=1.5)
+    with pytest.raises(ValueError):
+        SloSpec(windows_s=(60.0, 10.0))
+    with pytest.raises(ValueError):
+        SloSpec(windows_s=(10.0,))
+    assert not SloSpec().enabled
+    assert SloSpec(latency_threshold_ms=50.0).enabled
+    assert SloSpec(availability_target=0.999).enabled
+
+
+def test_burn_rate_math():
+    # burn 1.0 = consuming budget exactly at the sustainable rate.
+    assert burn_rate(0.01, 0.99) == pytest.approx(1.0)
+    assert burn_rate(0.5, 0.99) == pytest.approx(50.0)
+    assert burn_rate(0.0, 0.99) == 0.0
+    assert burn_rate(0.2, 0.9) == pytest.approx(2.0)
+
+
+def test_worst_ordering():
+    assert worst([]) == "ok"
+    assert worst(["ok", "warn"]) == "warn"
+    assert worst(["warn", "page", "ok"]) == "page"
+
+
+def test_no_traffic_is_ok():
+    clk = FakeClock()
+    tracker, _ = _tracker(clk)
+    assert tracker.latency_attainment(10.0) == 1.0
+    assert tracker.verdict() == "ok"
+    rep = tracker.report()
+    assert rep["verdict"] == "ok"
+    assert rep["slos"][0]["windows"]["10s"]["burn_rate"] == 0.0
+
+
+def test_moderate_burn_warns_not_pages():
+    """80 good + 20 bad out of 100 with a 10% budget: burn exactly 2.0 in
+    every window -> below page_burn(10) but over warn_burn(1) -> warn."""
+    clk = FakeClock()
+    tracker, _ = _tracker(clk)
+    m = tracker.metrics
+    for _ in range(80):
+        m.latency_w.observe(0.01)
+    for _ in range(20):
+        m.latency_w.observe(0.2)
+    assert tracker.latency_attainment(10.0) == pytest.approx(0.8)
+    rep = tracker.report()
+    (slo,) = rep["slos"]
+    assert slo["name"] == "latency_p90"
+    for w in ("10s", "60s", "300s"):
+        assert slo["windows"][w]["burn_rate"] == pytest.approx(2.0)
+    assert slo["verdict"] == "warn"
+    assert rep["verdict"] == "warn"
+
+
+def test_total_outage_pages():
+    """100% bad: burn = 1.0/0.1 = 10.0 in BOTH the short and mid windows
+    -> page (the fast-burn confirmation rule)."""
+    clk = FakeClock()
+    tracker, _ = _tracker(clk)
+    for _ in range(50):
+        tracker.metrics.latency_w.observe(0.5)
+    rep = tracker.report()
+    (slo,) = rep["slos"]
+    assert slo["windows"]["10s"]["burn_rate"] == pytest.approx(10.0)
+    assert slo["windows"]["60s"]["burn_rate"] == pytest.approx(10.0)
+    assert slo["verdict"] == "page"
+    assert tracker.verdict() == "page"
+
+
+def test_old_burst_decays_page_to_warn_then_ok():
+    """A total outage 200s ago: short/mid windows are clean (burn 0) so no
+    page, but the 300s window still burns >= warn_burn -> warn. Past 300s
+    the burst ages out entirely -> ok. A single burst can't page forever."""
+    clk = FakeClock()
+    tracker, _ = _tracker(clk)
+    for _ in range(50):
+        tracker.metrics.latency_w.observe(0.5)
+    clk.t += 200.0
+    rep = tracker.report()
+    (slo,) = rep["slos"]
+    assert slo["windows"]["10s"]["burn_rate"] == 0.0  # no data -> clean
+    assert slo["windows"]["60s"]["burn_rate"] == 0.0
+    assert slo["windows"]["300s"]["burn_rate"] == pytest.approx(10.0)
+    assert slo["verdict"] == "warn"
+    clk.t += 200.0  # 400s after the burst: outside every window
+    assert tracker.verdict() == "ok"
+
+
+def test_availability_slo_burn():
+    """99 ok + 1 bad against a 99.9% target: bad_fraction 0.01 over budget
+    0.001 -> burn 10 in every window -> page."""
+    clk = FakeClock()
+    spec = SloSpec(availability_target=0.999)
+    tracker = SloTracker(FakeMetrics(clk, 0.05), spec, clock=clk)
+    tracker.metrics.ok_w.add(99.0)
+    tracker.metrics.bad_w.add(1.0)
+    assert tracker.availability(10.0) == pytest.approx(0.99)
+    rep = tracker.report()
+    (slo,) = rep["slos"]
+    assert slo["name"] == "availability"
+    assert slo["windows"]["10s"]["burn_rate"] == pytest.approx(10.0)
+    assert slo["verdict"] == "page"
+
+
+def test_combined_slos_report_worst():
+    clk = FakeClock()
+    spec = SloSpec(latency_threshold_ms=50.0, latency_target=0.9,
+                   availability_target=0.999)
+    tracker = SloTracker(FakeMetrics(clk, 0.05), spec, clock=clk)
+    # Latency clean, availability paging.
+    for _ in range(100):
+        tracker.metrics.latency_w.observe(0.01)
+    tracker.metrics.bad_w.add(50.0)
+    rep = tracker.report()
+    by_name = {s["name"]: s["verdict"] for s in rep["slos"]}
+    assert by_name == {"latency_p90": "ok", "availability": "page"}
+    assert rep["verdict"] == "page"

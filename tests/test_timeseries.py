@@ -1,0 +1,190 @@
+"""Windowed metrics core (obs/timeseries.py): epoch-ring counters,
+windowed bucketed quantiles vs exact offline computation, mergeability."""
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.obs.timeseries import (
+    DEFAULT_LATENCY_BOUNDS,
+    WindowedCounter,
+    WindowedHistogram,
+    WindowedHistogramFamily,
+    attainment_from_counts,
+    bounds_with,
+    merge_counts,
+    quantile_from_counts,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------- WindowedCounter
+
+
+def test_counter_trailing_sum_and_rate():
+    clk = FakeClock()
+    c = WindowedCounter(max_window_s=300.0, clock=clk)
+    c.add(5.0)
+    clk.t += 5.0
+    c.add(3.0)
+    # Both events inside 10s; only the recent one inside 2s.
+    assert c.sum(10.0) == 8.0
+    assert c.sum(2.0) == 3.0
+    assert c.rate(10.0) == pytest.approx(0.8)
+    assert c.total == 8.0
+
+
+def test_counter_events_age_out():
+    clk = FakeClock()
+    c = WindowedCounter(max_window_s=60.0, clock=clk)
+    c.add(10.0)
+    clk.t += 30.0
+    assert c.sum(60.0) == 10.0
+    clk.t += 45.0  # 75s after the event: outside every window
+    assert c.sum(60.0) == 0.0
+    assert c.total == 10.0  # cumulative never decays
+
+
+def test_counter_long_idle_advance_clears_whole_ring():
+    clk = FakeClock()
+    c = WindowedCounter(max_window_s=10.0, clock=clk)
+    c.add(7.0)
+    clk.t += 10_000.0  # >> ring length: the lazy advance must full-clear
+    assert c.sum(10.0) == 0.0
+    c.add(1.0)
+    assert c.sum(10.0) == 1.0
+
+
+def test_counter_reset():
+    clk = FakeClock()
+    c = WindowedCounter(clock=clk)
+    c.add(4.0)
+    c.reset()
+    assert c.sum(10.0) == 0.0
+    assert c.total == 0.0
+
+
+# -------------------------------------------------------- count-array math
+
+
+def test_merge_counts_elementwise_and_length_check():
+    assert merge_counts([1, 2, 3], [4, 5, 6]) == [5, 7, 9]
+    with pytest.raises(ValueError, match="bucket bounds differ"):
+        merge_counts([1, 2], [1, 2, 3])
+
+
+def test_quantile_from_counts_empty_and_overflow():
+    bounds = (1.0, 2.0, 4.0)
+    assert quantile_from_counts(bounds, [0, 0, 0, 0], 50) == 0.0
+    # All mass in the overflow bucket clamps to the last finite bound.
+    assert quantile_from_counts(bounds, [0, 0, 0, 10], 99) == 4.0
+
+
+def test_attainment_exact_at_bucket_bound():
+    bounds = (1.0, 2.0, 4.0)
+    # 3 samples <= 2.0, 1 above: attainment at the bound is EXACT.
+    counts = [1, 2, 1, 0]
+    assert attainment_from_counts(bounds, counts, 2.0) == pytest.approx(0.75)
+    assert attainment_from_counts(bounds, counts, 4.0) == 1.0
+    assert attainment_from_counts(bounds, [0, 0, 0, 0], 2.0) == 1.0
+
+
+def test_bounds_with_inserts_threshold():
+    b = bounds_with(0.042)
+    assert 0.042 in b
+    assert list(b) == sorted(set(b))
+    # Existing bound and disabled threshold are no-ops.
+    assert bounds_with(0.05) == tuple(DEFAULT_LATENCY_BOUNDS)
+    assert bounds_with(0.0) == tuple(DEFAULT_LATENCY_BOUNDS)
+
+
+# ------------------------------------------------------- WindowedHistogram
+
+
+def test_windowed_quantiles_vs_exact_offline():
+    """Bucketed windowed quantiles must land inside the exact value's
+    containing bucket — the accuracy contract the docstring states."""
+    clk = FakeClock()
+    h = WindowedHistogram(clock=clk)
+    rng = np.random.default_rng(0)
+    samples = rng.lognormal(mean=-4.0, sigma=1.0, size=4000)  # ~18ms median
+    for v in samples:
+        h.observe(float(v))
+    bounds = (0.0,) + h.bounds
+    for p in (50, 90, 99):
+        exact = float(np.percentile(samples, p))
+        est = h.quantile(p, 60.0)
+        # Same bucket as the exact value: est within (lo, hi] of exact's bucket.
+        idx = int(np.searchsorted(h.bounds, exact))
+        lo = bounds[idx]
+        hi = h.bounds[idx] if idx < len(h.bounds) else h.bounds[-1]
+        assert lo <= est <= hi, (p, exact, est, lo, hi)
+
+
+def test_windowed_attainment_matches_exact_when_threshold_is_bound():
+    clk = FakeClock()
+    threshold = 0.042
+    h = WindowedHistogram(bounds=bounds_with(threshold), clock=clk)
+    rng = np.random.default_rng(1)
+    samples = rng.lognormal(mean=-3.2, sigma=0.8, size=2000)
+    for v in samples:
+        h.observe(float(v))
+    exact = float(np.mean(samples <= threshold))
+    assert h.attainment(threshold, 60.0) == pytest.approx(exact, abs=1e-12)
+    assert h.attainment(threshold, None) == pytest.approx(exact, abs=1e-12)
+
+
+def test_windowed_histogram_window_excludes_old_samples():
+    clk = FakeClock()
+    h = WindowedHistogram(clock=clk)
+    h.observe(0.001)
+    clk.t += 30.0
+    h.observe(1.0)
+    # 10s window sees only the recent slow sample; cumulative sees both.
+    assert h.window_count(10.0) == 1
+    assert h.quantile(50, 10.0) > 0.5
+    assert h.window_count(None) == 2
+    cum = h.cumulative()
+    assert cum["count"] == 2
+    assert cum["sum"] == pytest.approx(1.001)
+    assert cum["max"] == 1.0
+
+
+def test_windowed_histogram_summary_and_reset():
+    clk = FakeClock()
+    h = WindowedHistogram(clock=clk)
+    for v in (0.01, 0.02, 0.03):
+        h.observe(v)
+    s = h.window_summary(60.0)
+    assert s["count"] == 3
+    assert s["rate"] == pytest.approx(3 / 60.0)
+    assert 0.005 < s["p50"] <= 0.05
+    h.reset()
+    assert h.window_count(None) == 0
+    assert h.cumulative()["count"] == 0
+
+
+def test_windowed_histogram_rejects_unsorted_bounds():
+    with pytest.raises(ValueError, match="strictly increasing"):
+        WindowedHistogram(bounds=(2.0, 1.0))
+
+
+def test_windowed_family_per_label_series():
+    clk = FakeClock()
+    fam = WindowedHistogramFamily(clock=clk)
+    fam.observe("queue_wait", 0.005)
+    fam.observe("device", 0.05)
+    fam.observe("device", 0.06)
+    assert fam.labels() == ["device", "queue_wait"]
+    snap = fam.snapshot(60.0)
+    assert snap["device"]["count"] == 2
+    assert snap["queue_wait"]["count"] == 1
+    assert fam.get("missing") is None
+    fam.reset()
+    assert fam.labels() == []
