@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full obs-quick
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -19,6 +19,13 @@ obs-quick:
 	$(PY) -m pytest tests/test_timeseries.py tests/test_slo.py \
 	    tests/test_serve_health.py tests/test_fleet.py -q
 	$(PY) scripts/serve_bench.py --quick
+
+# Continuous-batching decode gate (sub-30s): real-engine greedy parity vs
+# the full-forward reference, closed-form stream routing through the slot
+# table, phase-sum <=25%, and the flush-vs-continuous A/B (continuous
+# >=1.5x tokens/s with TTFT p50 no worse; docs/PERF.md round 11).
+decode-quick:
+	$(PY) scripts/serve_bench.py --decode --quick
 
 # Static analysis + config sweep over the package; nonzero exit on any
 # non-baselined finding or stale baseline entry.

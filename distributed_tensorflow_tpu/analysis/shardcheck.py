@@ -172,17 +172,33 @@ def run_config_sweep(
     """
     from ..cli.train import PRESETS
     from ..models.bert import BertConfig
-    from ..serve.engine import BertInferenceEngine, plan_serve_mesh
+    from ..models.causal_lm import CausalLMConfig
+    from ..serve.engine import (
+        BertInferenceEngine,
+        CausalLMEngine,
+        plan_serve_mesh,
+    )
 
     findings: list[Finding] = []
     matrix: list[dict] = []
+    # Every preset with a transformer serving path: BERT one-shot scoring
+    # AND the causal-LM decode engines — a decode layout that only dies at
+    # executable build time is exactly the raw-XLA-error class SC002 exists
+    # to catch.
     presets = {
-        name: wl for name, wl in PRESETS.items() if "bert" in name.lower()
+        name: (wl, BertConfig, BertInferenceEngine)
+        for name, wl in PRESETS.items()
+        if "bert" in name.lower()
     }
-    for name, wl in presets.items():
-        # Mirror cli/serve.py config reconstruction: BertConfig defaults with
-        # the preset's geometry overrides. max_position/dtype don't affect
-        # the divisibility arithmetic under test.
+    presets.update({
+        name: (wl, CausalLMConfig, CausalLMEngine)
+        for name, wl in PRESETS.items()
+        if name.lower().startswith("lm")
+    })
+    for name, (wl, config_cls, engine_cls) in presets.items():
+        # Mirror cli/serve.py config reconstruction: config-class defaults
+        # with the preset's geometry overrides. max_position/dtype don't
+        # affect the divisibility arithmetic under test.
         overrides: dict = {}
         if wl.bert_layers:
             overrides["num_layers"] = wl.bert_layers
@@ -192,9 +208,9 @@ def run_config_sweep(
             )
         if wl.bert_vocab:
             overrides["vocab_size"] = wl.bert_vocab
-        if getattr(wl, "moe_experts", 0):
+        if getattr(wl, "moe_experts", 0) and config_cls is BertConfig:
             overrides["moe_experts"] = wl.moe_experts
-        base_cfg = BertConfig(**overrides)
+        base_cfg = config_cls(**overrides)
 
         for tp, pp, ep in layouts:
             cell = {"preset": name, "tp": tp, "pp": pp, "ep": ep}
@@ -224,11 +240,13 @@ def run_config_sweep(
                 matrix.append(cell)
                 continue
             cfg = base_cfg
-            if pp > 1:
-                # cli/serve.py sets pipeline_parallel from --pp at load time.
+            if pp > 1 and config_cls is BertConfig:
+                # cli/serve.py sets pipeline_parallel from --pp at load time
+                # (the decoder config has no pipeline field — its engine
+                # rejects pp>1 outright, which is the outcome under test).
                 cfg = BertConfig(**{**overrides, "pipeline_parallel": pp})
             try:
-                BertInferenceEngine._serve_config(cfg, tp=tp, ep=ep, pp=pp)
+                engine_cls._serve_config(cfg, tp=tp, ep=ep, pp=pp)
                 cell["outcome"] = "serves"
             except ValueError as exc:
                 # Designed loud rejection (clean startup error, no XLA trace).
@@ -240,7 +258,7 @@ def run_config_sweep(
                         check="SC002",
                         path="distributed_tensorflow_tpu/serve/engine.py",
                         line=0,
-                        scope="BertInferenceEngine._serve_config",
+                        scope=f"{engine_cls.__name__}._serve_config",
                         message=(
                             f"layout tp={tp} pp={pp} ep={ep} on preset '{name}' "
                             f"raised {type(exc).__name__} instead of a clean "

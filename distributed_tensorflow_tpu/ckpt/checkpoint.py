@@ -88,7 +88,9 @@ class Checkpointer:
         self.close()
 
 
-def restore_serving_state(directory: str | Path, template_state: Any):
+def restore_serving_state(
+    directory: str | Path, template_state: Any, *, release_opt_state: bool = True
+):
     """Load the newest training checkpoint for the INFERENCE engine.
 
     ``template_state`` is a TrainState built exactly like the training run's
@@ -103,9 +105,19 @@ def restore_serving_state(directory: str | Path, template_state: Any):
     for models too big for one chip. Returns ``(params, model_state,
     step)``. Raises ``FileNotFoundError`` when the directory holds no
     checkpoint: serving must never silently answer from random init.
+
+    ``release_opt_state=True`` (the default) deletes the restored optimizer
+    slots' and gradient ring's device buffers before returning — serving
+    never reads them, and for an AdamW checkpoint they are 2x the params.
+    The reclaimed HBM is what a decode engine's KV-cache pages live in, so
+    leaving them resident would shrink the slot budget for nothing.
     """
     with Checkpointer(directory, use_async=False) as ckpt:
         if ckpt.latest_step() is None:
             raise FileNotFoundError(f"no checkpoint found under {directory}")
         state, step = ckpt.restore_latest(template_state)
+    if release_opt_state:
+        for leaf in jax.tree.leaves((state.opt_state, state.grad_buffer)):
+            if isinstance(leaf, jax.Array):
+                leaf.delete()
     return state.params, state.model_state, step

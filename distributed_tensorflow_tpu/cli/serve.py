@@ -80,6 +80,7 @@ def build_serving_client(cfg, args):
     from distributed_tensorflow_tpu.serve import (
         BatcherConfig,
         BertInferenceEngine,
+        CausalLMEngine,
         Client,
         ImageClassifierEngine,
     )
@@ -146,6 +147,28 @@ def build_serving_client(cfg, args):
         def make_payload(rng: np.random.Generator) -> dict:
             return {"image": rng.standard_normal(shape).astype(np.float32)}
 
+    elif pieces.get("decode"):
+        engine = CausalLMEngine(
+            pieces["model"],
+            params,
+            mesh,
+            buckets=tuple(args.buckets),
+            slots=args.slots,
+            max_batch=args.max_batch,
+            batch_tiers=tuple(args.batch_tiers),
+            max_new_tokens=args.max_new_tokens,
+        )
+        vocab = pieces["model"].cfg.vocab_size
+
+        def make_payload(rng: np.random.Generator) -> dict:
+            l = int(rng.integers(4, engine.buckets[-1] + 1))
+            return {
+                "input_ids": rng.integers(5, vocab, size=l),
+                "max_new_tokens": int(
+                    rng.integers(1, args.max_new_tokens + 1)
+                ),
+            }
+
     else:
         engine = BertInferenceEngine(
             pieces["model"],
@@ -184,6 +207,8 @@ def build_serving_client(cfg, args):
         ),
         tracer=Tracer(buffer_size=buf, enabled=buf > 0),
         slo=slo,
+        admission="flush" if getattr(args, "flush_admission", False)
+        else "continuous",
     )
     return client, make_payload
 
@@ -235,6 +260,20 @@ def main(argv: list[str] | None = None):
                         help="queue bound; beyond -> 429 + Retry-After")
     parser.add_argument("--top-k", type=int, default=5,
                         help="classes returned per classify request")
+    # Decode engine (causal-LM presets; see DEPLOY.md "Continuous-batching
+    # decode"). Requests admit into KV-cache slots mid-flight between
+    # decode steps unless --flush-admission pins static batching.
+    parser.add_argument("--slots", type=int, default=8,
+                        help="KV-cache slots = max concurrently decoding "
+                        "sequences (one fixed decode executable at this "
+                        "width)")
+    parser.add_argument("--max-new-tokens", type=int, default=32,
+                        help="generation cap per request (requests may ask "
+                        "for less; also sizes the per-slot cache pages)")
+    parser.add_argument("--flush-admission", action="store_true",
+                        help="admit new requests only when the slot table "
+                        "is EMPTY (static batching; the A/B baseline for "
+                        "continuous admission)")
     # Multi-chip serving mesh (BERT engines; see DEPLOY.md "Multi-chip
     # serving"). A layout that doesn't fit the device count falls back to
     # single-chip DP with a warning.
@@ -327,7 +366,9 @@ def main(argv: list[str] | None = None):
             "/statusz /tracez /metrics?format=prom, POST /profilez "
             "/drainz)",
             *server.server_address,
-            "classify" if hasattr(client.engine, "image_shape") else "mlm",
+            "classify" if hasattr(client.engine, "image_shape")
+            else "generate" if hasattr(client.engine, "decode")
+            else "mlm",
         )
         try:
             server.serve_forever()

@@ -536,6 +536,99 @@ def _build_bert_workload(cfg_kwargs: dict):
     return build
 
 
+def _build_causal_lm_workload(cfg_kwargs: dict):
+    def build(cfg: WorkloadConfig):
+        from distributed_tensorflow_tpu.data.text import (
+            SyntheticLM,
+            SyntheticMLMConfig,
+            lm_batch_specs,
+            mlm_device_batches,
+        )
+        from distributed_tensorflow_tpu.models.causal_lm import (
+            CausalLM,
+            CausalLMConfig,
+            causal_param_specs,
+            make_causal_lm_eval_metrics,
+            make_causal_lm_loss,
+        )
+
+        def make(mesh):
+            tp = mesh.shape.get("model", 1)
+            ep = mesh.shape.get("expert", 1)
+            pp = mesh.shape.get("pipeline", 1)
+            if ep > 1 or pp > 1:
+                raise ValueError(
+                    "causal-LM workloads shard over data/model axes only "
+                    "(no MoE or pipeline decoder variant)"
+                )
+            kwargs = dict(cfg_kwargs)
+            if cfg.bert_layers:
+                kwargs["num_layers"] = cfg.bert_layers
+            if cfg.bert_hidden:
+                kwargs["hidden_size"] = cfg.bert_hidden
+                kwargs["intermediate_size"] = 4 * cfg.bert_hidden
+            if cfg.bert_vocab:
+                kwargs["vocab_size"] = cfg.bert_vocab
+            init_cfg = CausalLMConfig(**kwargs)
+            model_cfg = init_cfg
+            if tp > 1:
+                model_cfg = dataclasses.replace(
+                    model_cfg, model_axis="model", model_parallel=tp
+                )
+            # Init outside shard_map must not bind the model axis; the
+            # param tree is identical either way (same rule as BERT).
+            init_model_ = CausalLM(init_cfg)
+            model = CausalLM(model_cfg)
+            L = init_cfg.max_position
+            variables = init_model_.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, L), jnp.int32),
+                jnp.ones((1, L), bool),
+            )
+            data = SyntheticLM(
+                SyntheticMLMConfig(
+                    vocab_size=init_cfg.vocab_size, seq_len=L, seed=0
+                )
+            )
+
+            def eval_batches(n_batches: int) -> Iterator[dict]:
+                it = mlm_device_batches(
+                    data, mesh, cfg.global_batch, seed=900_001
+                )
+                for _ in range(n_batches):
+                    yield next(it)
+
+            return {
+                "params": variables["params"],
+                # Serving hooks (cli/serve.py): axis-free model + the
+                # decode marker that routes the config to CausalLMEngine's
+                # prefill/decode grid instead of the one-shot BERT path.
+                "model": init_model_,
+                "decode": True,
+                "param_specs": (
+                    causal_param_specs(variables["params"])
+                    if tp > 1
+                    else None
+                ),
+                "model_state": {},
+                "loss_fn": make_causal_lm_loss(model),
+                "batches": lambda start_step=0: mlm_device_batches(
+                    data,
+                    mesh,
+                    cfg.global_batch,
+                    seed=1,
+                    start_step=start_step,
+                ),
+                "batch_spec": lm_batch_specs(mesh),
+                "metric_fn": make_causal_lm_eval_metrics(model),
+                "eval_batches": eval_batches,
+            }
+
+        return make
+
+    return build
+
+
 def _presets() -> dict[str, WorkloadConfig]:
     from distributed_tensorflow_tpu.models import (
         InceptionV3,
@@ -612,6 +705,22 @@ def _presets() -> dict[str, WorkloadConfig]:
             # weight decay (masked off LayerNorm scales and all biases —
             # _decay_mask) + spec-aware global-norm clipping at 1.0
             # (applied inside the step; see make_train_step clip_norm).
+            optimizer="adamw",
+            weight_decay=0.01,
+            clip_norm=1.0,
+            lr_schedule="warmup_cosine",
+            warmup_steps=1000,
+        ),
+        "lm_base": WorkloadConfig(
+            name="lm_base",
+            build=_build_causal_lm_workload(
+                dict(max_position=128, dtype=jnp.bfloat16)
+            ),
+            global_batch=256,
+            num_steps=10000,
+            learning_rate=1e-4,
+            # Same decoupled-decay recipe as bert_base — the decoder reuses
+            # its blocks, so the optimizer hygiene carries over unchanged.
             optimizer="adamw",
             weight_decay=0.01,
             clip_norm=1.0,

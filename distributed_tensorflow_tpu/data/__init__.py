@@ -30,8 +30,10 @@ from distributed_tensorflow_tpu.data.prefetch import (  # noqa: F401
     prefetch,
 )
 from distributed_tensorflow_tpu.data.text import (  # noqa: F401
+    SyntheticLM,
     SyntheticMLM,
     SyntheticMLMConfig,
     bert_batch_specs,
+    lm_batch_specs,
     mlm_device_batches,
 )

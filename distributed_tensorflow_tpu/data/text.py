@@ -111,6 +111,29 @@ class SyntheticMLM:
         }
 
 
+class SyntheticLM(SyntheticMLM):
+    """Left-to-right causal-LM batches over the same Markov chains:
+    ``input_ids`` / ``attention_mask`` only (next-token prediction needs no
+    masking pass, so ``cfg.mask_prob`` is unused). Row lengths vary over
+    ``[L/2, L]`` with PAD tails so the shift-by-one loss weighting is
+    actually exercised, not a constant."""
+
+    def batch(
+        self, batch_size: int, *, seed: int | tuple[int, ...]
+    ) -> dict[str, np.ndarray]:
+        cfg = self.cfg
+        key = (seed,) if isinstance(seed, int) else tuple(seed)
+        rng = np.random.default_rng((cfg.seed, *key))
+        L = cfg.seq_len
+        ids = np.empty((batch_size, L), np.int32)
+        ids[:, 0] = CLS
+        ids[:, 1:] = self._chains(rng, batch_size, L - 1)
+        lengths = rng.integers(max(2, L // 2), L + 1, batch_size)
+        attention_mask = np.arange(L)[None, :] < lengths[:, None]
+        ids[~attention_mask] = PAD
+        return {"input_ids": ids, "attention_mask": attention_mask}
+
+
 UNK = 4
 NUM_SPECIAL_TEXT = 5  # PAD CLS SEP MASK UNK
 
@@ -299,6 +322,19 @@ def bert_batch_specs(
         "token_type_ids": spec_2d,
         "mlm_targets": spec_2d,
         "nsp_label": spec_1d,
+    }
+
+
+def lm_batch_specs(mesh) -> dict:
+    """Per-leaf PartitionSpecs for a causal-LM batch (ids + mask only):
+    batch dim over the DP axes, sequence replicated."""
+    from distributed_tensorflow_tpu.parallel.mesh import data_axes
+
+    dp = data_axes(mesh)
+    dp_spec = dp if dp else None
+    return {
+        "input_ids": P(dp_spec, None),
+        "attention_mask": P(dp_spec, None),
     }
 
 

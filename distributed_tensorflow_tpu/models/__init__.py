@@ -27,3 +27,11 @@ from distributed_tensorflow_tpu.models.bert import (  # noqa: F401
     bert_base,
     make_bert_pretraining_loss,
 )
+from distributed_tensorflow_tpu.models.causal_lm import (  # noqa: F401
+    CausalLM,
+    CausalLMConfig,
+    causal_lm_base,
+    causal_param_specs,
+    make_causal_lm_loss,
+    sample_tokens,
+)

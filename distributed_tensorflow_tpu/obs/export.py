@@ -146,6 +146,15 @@ def serve_families(metrics, slo=None, health=None) -> list[Family]:
                "requests waiting in the batcher").add(m.queue_depth.value),
         Family("serve_in_flight", "gauge",
                "batches dispatched but not yet fetched").add(m.in_flight.value),
+        # Decode (continuous-batching) families — zero-valued but present
+        # on scoring-only replicas, so dashboards need no per-mode wiring.
+        Family("serve_tokens_total", "counter",
+               "generated tokens delivered").add(m.tokens.value),
+        Family("serve_decode_steps_total", "counter",
+               "decode-step executions over the slot table")
+        .add(m.decode_steps.value),
+        Family("serve_slots_active", "gauge",
+               "occupied KV-cache slots").add(m.slots_active.value),
     ]
 
     by_cause = Family("serve_rejected_by_cause_total", "counter",
@@ -208,6 +217,20 @@ def serve_families(metrics, slo=None, health=None) -> list[Family]:
         "per-request phase latency quantiles (sample-ring estimator)",
         phase_summaries,
     ))
+    # Per-token latency quantiles (decode path; per-token samples also ride
+    # the phase family as "decode_step").
+    if m.ttft.summary()["count"]:
+        fams.append(_summary_quantiles(
+            "serve_ttft_quantile_seconds",
+            "submit->first-token latency quantiles",
+            {(): m.ttft.summary()},
+        ))
+    if m.itl.summary()["count"]:
+        fams.append(_summary_quantiles(
+            "serve_itl_quantile_seconds",
+            "inter-token latency quantiles",
+            {(): m.itl.summary()},
+        ))
 
     if getattr(m, "windowed", False):
         # Native histograms from the windowed families' cumulative counts.
@@ -234,6 +257,7 @@ def serve_families(metrics, slo=None, health=None) -> list[Family]:
             for series, c in (
                 ("requests", m.requests_w), ("ok", m.ok_w),
                 ("rejected", m.rejected_w), ("failed", m.bad_w),
+                ("tokens", m.tokens_w),
             ):
                 rates.add(c.rate(w), {"window": wl, "series": series})
             summ = m.latency_w.window_summary(w)

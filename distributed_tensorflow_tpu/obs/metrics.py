@@ -339,6 +339,16 @@ class ServeMetrics:
         # (queue full), "validation" (RequestError at submit),
         # "engine_failure" (batch raised mid-flight), "closed".
         self.rejected_by_cause = LabelledCounter()
+        # ------------------------------------------------- decode families
+        # Per-token observability for the continuous-batching decode path
+        # (serve/batcher.ContinuousBatcher). Per-token latency itself rides
+        # the phase family as "decode_step" (one sample per fetched token);
+        # these are the aggregates that family can't carry.
+        self.tokens = Counter()        # generated tokens delivered
+        self.decode_steps = Counter()  # decode-step executions (all slots)
+        self.slots_active = Gauge()    # occupied KV-cache slots
+        self.ttft = Histogram()        # seconds, submit -> first token
+        self.itl = Histogram()         # seconds between consecutive tokens
         # ------------------------------------------------ windowed families
         # (obs/timeseries.py) — the SLO/health layer's inputs.  bad_w
         # counts requests that burned availability budget (backpressure +
@@ -352,6 +362,7 @@ class ServeMetrics:
         self.ok_w = WindowedCounter()         # delivered results
         self.bad_w = WindowedCounter()        # budget-burning failures
         self.rejected_w = WindowedCounter()   # backpressure sheds only
+        self.tokens_w = WindowedCounter()     # generated tokens (tokens/s)
 
     def observe_phase(self, name: str, seconds: float, layout: str = "") -> None:
         """Record one per-request phase sample, double-keyed by the engine's
@@ -391,6 +402,7 @@ class ServeMetrics:
                 "ok_rate": self.ok_w.rate(w),
                 "rejected_rate": self.rejected_w.rate(w),
                 "failure_rate": self.bad_w.rate(w),
+                "token_rate": self.tokens_w.rate(w),
                 "latency_ms": {
                     "count": lat["count"],
                     "p50": lat["p50"] * 1e3,
@@ -420,6 +432,17 @@ class ServeMetrics:
             "layout_tier_hits": self.layout_tier_hits.snapshot(),
             "layout_bucket_hits": self.layout_bucket_hits.snapshot(),
             "rejected_by_cause": self.rejected_by_cause.snapshot(),
+            "tokens": self.tokens.value,
+            "decode_steps": self.decode_steps.value,
+            "slots_active": self.slots_active.value,
+            "ttft_ms": {
+                k: (v * 1e3 if k != "count" else v)
+                for k, v in self.ttft.summary().items()
+            },
+            "itl_ms": {
+                k: (v * 1e3 if k != "count" else v)
+                for k, v in self.itl.summary().items()
+            },
             "phase_ms": {
                 phase: {
                     k: (v * 1e3 if k != "count" else v)

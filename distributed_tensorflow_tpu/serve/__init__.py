@@ -21,10 +21,12 @@ Entry point: ``python -m distributed_tensorflow_tpu.cli.serve``.
 from distributed_tensorflow_tpu.serve.batcher import (  # noqa: F401
     Backpressure,
     BatcherConfig,
+    ContinuousBatcher,
     DynamicBatcher,
 )
 from distributed_tensorflow_tpu.serve.engine import (  # noqa: F401
     BertInferenceEngine,
+    CausalLMEngine,
     ImageClassifierEngine,
     InFlightBatch,
     RequestError,

@@ -203,6 +203,7 @@ class DynamicBatcher:
         with self._cv:
             return {
                 "closed": self._closed,
+                "mode": "flush",
                 "queue_depth": self._count,
                 "max_queue": self.config.max_queue,
                 "in_flight": self._n_inflight,
@@ -463,6 +464,497 @@ class DynamicBatcher:
             t.name
             for t in (self._thread, self._fetch_thread)
             if t is not None and t.is_alive()
+        ]
+        if stuck:
+            msg = (
+                f"batcher thread(s) {stuck} still running after "
+                f"{join_timeout_s:.0f}s close timeout — engine likely wedged"
+            )
+            logger.error(msg)
+            raise RuntimeError(msg)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _Slot:
+    """Host bookkeeping for one KV-cache slot's occupant. Every field is
+    owned by ``ContinuousBatcher._cv``; ``gen`` disambiguates a reused slot
+    from the occupant an in-flight step was dispatched for."""
+
+    __slots__ = (
+        "pending", "gen", "prompt_len", "length", "max_new", "eos_id",
+        "temperature", "seed", "tokens", "n_dispatched", "t_first",
+        "t_last_tok",
+    )
+
+    def __init__(self, pending: _Pending, gen: int, payload: dict,
+                 default_max_new: int):
+        self.pending = pending
+        self.gen = gen
+        self.prompt_len = len(payload["input_ids"])
+        self.length = self.prompt_len   # cache pages written (advances at
+        self.n_dispatched = 0           # DISPATCH, so steps pipeline)
+        self.max_new = int(payload.get("max_new_tokens", default_max_new))
+        eos = payload.get("eos_id")
+        self.eos_id = None if eos is None else int(eos)
+        self.temperature = float(payload.get("temperature", 0.0))
+        self.seed = int(payload.get("seed", 0))
+        self.tokens: list[int] = []
+        self.t_first = 0.0
+        self.t_last_tok = 0.0
+
+
+class ContinuousBatcher:
+    """Slot-table scheduler over a decode engine: continuous batching.
+
+    Where :class:`DynamicBatcher` flushes a batch and waits for it, this
+    batcher owns a fixed table of ``engine.slots`` KV-cache slots and runs
+    an endless decode loop over whichever slots are live: new requests are
+    admitted into FREE slots between decode steps (a prefill dispatch
+    joins them to the in-flight batch), and a finished sequence frees its
+    slot immediately — the next queued request takes it on the very next
+    iteration, so occupancy never collapses to the slowest member the way
+    a static batch does. ``admission="flush"`` keeps the same machinery
+    but only admits when the table is EMPTY — the static-batching baseline
+    the serve_bench decode A/B measures against.
+
+    Threading mirrors the pipelined DynamicBatcher: the decode-loop thread
+    is the only engine dispatcher (the engine's device-state swap is
+    single-writer by that contract), a completion thread fetches each
+    step's sampled tokens, and ``max_in_flight`` bounds
+    dispatched-but-unfetched steps — host lengths advance at DISPATCH
+    time, so step k+1 launches against step k's still-un-fetched device
+    state and the token fetch overlaps the next step's compute. Slot reuse
+    while stale steps are in flight is safe on both sides: host-side a
+    per-slot generation tag drops stale tokens, device-side every cache
+    page is re-written (by the new occupant's prefill or decode) before
+    anything reads it, and dispatch order means stale writes land first.
+
+    Per-request results resolve on the submit Future as ``{"tokens",
+    "n_tokens", "prompt_len", "bucket"}`` with contiguous phases
+    ``queue_wait -> prefill -> decode`` summing to wall latency by
+    construction; per-token observability rides the ``decode_step`` phase
+    family (inter-token latencies), the ``ttft`` histogram, and the
+    ``tokens`` / ``tokens_w`` counters.
+    """
+
+    # Watched by obs.sanitizer.sanitize_races in tests/test_serve_decode.py;
+    # every access must be ordered by self._cv.
+    _RACETRACE_ATTRS = (
+        "_queue", "_count", "_closed", "_slots", "_n_active", "_n_inflight",
+    )
+
+    def __init__(
+        self,
+        engine,
+        config: BatcherConfig | None = None,
+        metrics: ServeMetrics | None = None,
+        *,
+        admission: str = "continuous",
+        tracer=None,
+        layout: str = "",
+    ):
+        if admission not in ("continuous", "flush"):
+            raise ValueError(
+                f"admission must be 'continuous' or 'flush', got {admission!r}"
+            )
+        self.config = config or BatcherConfig()
+        self.metrics = metrics or ServeMetrics()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self._layout = layout or getattr(engine, "layout", "")
+        self._engine = engine
+        self._admission = admission
+        self._admit_cap = min(self.config.max_batch, engine.max_batch)
+        self._default_max_new = getattr(engine, "max_new_tokens", 32)
+        self._req_ids = itertools.count()
+        self._gens = itertools.count(1)
+        self._cv = threading.Condition()
+        self._queue: deque[_Pending] = deque()
+        self._count = 0
+        self._closed = False
+        self._slots: list[_Slot | None] = [None] * engine.slots
+        self._n_active = 0
+        self._n_inflight = 0
+        self._inflight_sem = threading.BoundedSemaphore(
+            self.config.max_in_flight
+        )
+        self._completion: queue.Queue = queue.Queue()
+        self._fetch_thread = threading.Thread(
+            target=self._completion_loop, name="serve-decode-fetch",
+            daemon=True,
+        )
+        self._fetch_thread.start()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-decode", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, payload, request_id: str | None = None) -> Future:
+        """Enqueue one generation request (same Future/Backpressure contract
+        as :meth:`DynamicBatcher.submit`); it joins the slot table at the
+        next admission point — between decode steps, not behind a flush."""
+        if request_id is None:
+            request_id = f"r-{next(self._req_ids):08d}"
+        metrics = self.metrics  # local: instruments carry their own locks
+        with self._cv:
+            if self._closed:
+                metrics.rejected_by_cause.inc("closed")
+                if metrics.windowed:
+                    metrics.bad_w.add(1.0)
+                raise RuntimeError("batcher is closed")
+            if self._count >= self.config.max_queue:
+                metrics.rejected.inc()
+                metrics.rejected_by_cause.inc("backpressure")
+                if metrics.windowed:
+                    metrics.rejected_w.add(1.0)
+                    metrics.bad_w.add(1.0)
+                self.tracer.instant(
+                    "rejected", "serve", request_id=request_id,
+                    cause="backpressure", queue_depth=self._count,
+                )
+                exc = Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
+                exc.request_id = request_id
+                raise exc
+            pending = _Pending(payload, request_id)
+            pending.future.request_id = request_id
+            self._queue.append(pending)
+            self._count += 1
+            metrics.requests.inc()
+            metrics.queue_depth.set(self._count)
+            self._cv.notify_all()
+        if metrics.windowed:
+            metrics.requests_w.add(1.0)
+        return pending.future
+
+    def status(self) -> dict:
+        with self._cv:
+            return {
+                "closed": self._closed,
+                "mode": self._admission,
+                "queue_depth": self._count,
+                "max_queue": self.config.max_queue,
+                "in_flight": self._n_inflight,
+                "max_in_flight": self.config.max_in_flight,
+                "slots": len(self._slots),
+                "slots_active": self._n_active,
+            }
+
+    # --------------------------------------------------------- decode loop
+
+    def _steppable(self, s: _Slot | None) -> bool:
+        """Include the slot in the next decode step? Occupied, and not
+        every requested token already dispatched (a slot whose last tokens
+        are still in flight rides along inactive until they fetch)."""
+        return s is not None and s.n_dispatched < s.max_new
+
+    def _take_work(self):
+        """Block until there is something to dispatch; returns
+        ``(admissions, step)`` — either may be empty/None — or None when
+        closed and fully drained. All bookkeeping (slot assignment, length
+        advance) happens HERE under ``_cv``; the caller just dispatches."""
+        with self._cv:
+            while True:
+                if (
+                    self._closed
+                    and not self._queue
+                    and self._n_active == 0
+                ):
+                    return None
+                admissions = []
+                free = [
+                    i for i, s in enumerate(self._slots) if s is None
+                ]
+                may_admit = self._queue and free and (
+                    self._admission == "continuous" or self._n_active == 0
+                )
+                if may_admit:
+                    now = time.monotonic()
+                    for slot_id in free[: min(len(self._queue),
+                                              self._admit_cap)]:
+                        p = self._queue.popleft()
+                        self._count -= 1
+                        p.t_taken = now  # queue_wait phase ends here
+                        slot = _Slot(
+                            p, next(self._gens), p.payload,
+                            self._default_max_new,
+                        )
+                        slot.n_dispatched = 1  # the prefill's first token
+                        self._slots[slot_id] = slot
+                        self._n_active += 1
+                        admissions.append((slot_id, slot))
+                    self.metrics.queue_depth.set(self._count)
+                    self.metrics.slots_active.set(self._n_active)
+                step = None
+                rows = [
+                    (i, s) for i, s in enumerate(self._slots)
+                    if self._steppable(s)
+                ]
+                if rows:
+                    n = len(self._slots)
+                    lengths = [0] * n
+                    active = [False] * n
+                    temps = [0.0] * n
+                    seeds = [0] * n
+                    tags = []
+                    for i, s in rows:
+                        lengths[i] = s.length
+                        active[i] = True
+                        temps[i] = s.temperature
+                        seeds[i] = s.seed
+                        s.length += 1         # advances at dispatch: steps
+                        s.n_dispatched += 1   # pipeline without the fetch
+                        tags.append((i, s.gen))
+                    step = (lengths, active, temps, seeds, tags)
+                if admissions or step:
+                    return admissions, step
+                self._cv.wait()
+
+    def _fail_slots(self, tagged: list[tuple[int, int]],
+                    exc: BaseException) -> None:
+        """Fail + free the (slot, gen) occupants (engine dispatch/fetch
+        blew up under them)."""
+        metrics = self.metrics  # local: instruments carry their own locks
+        victims = []
+        with self._cv:
+            for slot_id, gen in tagged:
+                s = self._slots[slot_id]
+                if s is None or s.gen != gen:
+                    continue
+                self._slots[slot_id] = None
+                self._n_active -= 1
+                victims.append(s.pending)
+            metrics.slots_active.set(self._n_active)
+            self._cv.notify_all()
+        if not victims:
+            return
+        metrics.errors.inc()
+        metrics.rejected_by_cause.inc("engine_failure", len(victims))
+        if metrics.windowed:
+            metrics.bad_w.add(float(len(victims)))
+        for p in victims:
+            self.tracer.instant(
+                "engine_failure", "serve", request_id=p.request_id,
+                error=type(exc).__name__,
+            )
+            if not p.future.cancelled():
+                p.future.set_exception(exc)
+        logger.warning(
+            "decode dispatch failed (%s): request_ids=%s",
+            type(exc).__name__, [p.request_id for p in victims],
+        )
+
+    def _loop(self):
+        engine = self._engine
+        while True:
+            work = self._take_work()
+            if work is None:
+                self._completion.put(None)  # unblock the fetch thread
+                return
+            admissions, step = work
+            if admissions:
+                self.metrics.batches.inc()
+                self.metrics.batch_occupancy.observe(len(admissions))
+                self._inflight_sem.acquire()
+                tags = [(i, s.gen) for i, s in admissions]
+                try:
+                    handle = engine.prefill([
+                        {
+                            "slot": i,
+                            "input_ids": s.pending.payload["input_ids"],
+                            "temperature": s.temperature,
+                            "seed": s.seed,
+                        }
+                        for i, s in admissions
+                    ])
+                except Exception as e:  # noqa: BLE001 — fail the rows, not the server
+                    # Fail ONLY the admitted rows; the step planned below
+                    # still dispatches (its bookkeeping already advanced,
+                    # and the failed slots' lanes are dead via the gen tag).
+                    self._inflight_sem.release()
+                    self._fail_slots(tags, e)
+                else:
+                    with self._cv:
+                        self._n_inflight += 1
+                        self.metrics.in_flight.set(self._n_inflight)
+                    self._completion.put(
+                        ("prefill", tags, handle, time.monotonic())
+                    )
+            if step:
+                lengths, active, temps, seeds, tags = step
+                self._inflight_sem.acquire()
+                try:
+                    handle = engine.decode(lengths, active, temps, seeds)
+                except Exception as e:  # noqa: BLE001
+                    self._inflight_sem.release()
+                    self._fail_slots(tags, e)
+                    continue
+                with self._cv:
+                    self._n_inflight += 1
+                    self.metrics.in_flight.set(self._n_inflight)
+                self._completion.put(
+                    ("decode", tags, handle, time.monotonic())
+                )
+
+    # ---------------------------------------------------------- completion
+
+    def _append_token(self, slot_id: int, s: _Slot, token: int,
+                      t_got: float, finished: list) -> None:
+        """Record one fetched token; on eos/max_new, resolve the future and
+        free the slot IMMEDIATELY (in-flight steps for the old occupant are
+        dropped by the gen tag; their cache writes are dead stores)."""
+        s.tokens.append(token)
+        s.t_last_tok = t_got
+        done = (
+            len(s.tokens) >= s.max_new
+            or (s.eos_id is not None and token == s.eos_id)
+        )
+        if done:
+            self._slots[slot_id] = None
+            self._n_active -= 1
+            finished.append(s)
+
+    def _resolve(self, finished: list[_Slot], now: float) -> None:
+        """Resolve finished occupants' futures outside ``_cv`` with the
+        DynamicBatcher delivery contract: contiguous phases, exact
+        ``latency_s``, batch-held metric locks, metrics before futures."""
+        metrics, tracer = self.metrics, self.tracer
+        latencies = []
+        phase_values: dict[str, list[float]] = {}
+        for s in finished:
+            p = s.pending
+            latency = now - p.t_enqueue
+            metrics.latency.observe(latency)
+            latencies.append(latency)
+            p.future.latency_s = latency
+            phases = {
+                "queue_wait": p.t_taken - p.t_enqueue,
+                "prefill": s.t_first - p.t_taken,
+                "decode": now - s.t_first,
+            }
+            for name, dt in phases.items():
+                phase_values.setdefault(name, []).append(dt)
+            p.future.phases = phases
+            tracer.record("request", p.t_enqueue, now, cat="serve",
+                          request_id=p.request_id)
+            tracer.record("queue_wait", p.t_enqueue, p.t_taken, cat="serve",
+                          request_id=p.request_id)
+            tracer.record("prefill", p.t_taken, s.t_first, cat="serve",
+                          request_id=p.request_id)
+            tracer.record("decode", s.t_first, now, cat="serve",
+                          request_id=p.request_id)
+        for name, vals in phase_values.items():
+            metrics.observe_phase_batch(name, vals, self._layout, now)
+        if metrics.windowed:
+            metrics.latency_w.observe_many(latencies, now)
+            metrics.ok_w.add(float(len(finished)), now)
+        for s in finished:
+            p = s.pending
+            if not p.future.cancelled():
+                p.future.set_result({
+                    "tokens": list(s.tokens),
+                    "n_tokens": len(s.tokens),
+                    "prompt_len": s.prompt_len,
+                    "bucket": self._engine.bucket_for(s.prompt_len),
+                })
+
+    def _completion_loop(self):
+        engine, metrics = self._engine, self.metrics
+        while True:
+            item = self._completion.get()
+            if item is None:
+                return
+            kind, tags, handle, t_disp = item
+            try:
+                tok = engine.fetch_step(handle)
+            except Exception as e:  # noqa: BLE001
+                self._fail_slots(tags, e)
+                with self._cv:
+                    self._n_inflight -= 1
+                    metrics.in_flight.set(self._n_inflight)
+                self._inflight_sem.release()
+                continue
+            t_got = getattr(handle, "t_got", 0.0) or time.monotonic()
+            finished: list[_Slot] = []
+            itls: list[float] = []
+            ttfts: list[float] = []
+            n_tokens = 0
+            with self._cv:
+                if kind == "prefill":
+                    for r, (slot_id, gen) in enumerate(tags):
+                        s = self._slots[slot_id]
+                        if s is None or s.gen != gen:
+                            continue
+                        s.t_first = t_got
+                        ttfts.append(t_got - s.pending.t_enqueue)
+                        n_tokens += 1
+                        self._append_token(
+                            slot_id, s, int(tok[r]), t_got, finished
+                        )
+                else:
+                    for slot_id, gen in tags:
+                        s = self._slots[slot_id]
+                        if s is None or s.gen != gen:
+                            continue
+                        itls.append(t_got - s.t_last_tok)
+                        n_tokens += 1
+                        self._append_token(
+                            slot_id, s, int(tok[slot_id]), t_got, finished
+                        )
+                self._n_inflight -= 1
+                metrics.in_flight.set(self._n_inflight)
+                metrics.slots_active.set(self._n_active)
+                self._cv.notify_all()
+            self._inflight_sem.release()
+            # Metric recording outside _cv (instruments self-lock), before
+            # futures resolve so a joiner sees its own samples.
+            if kind == "decode":
+                metrics.decode_steps.inc()
+                if self.tracer.enabled:
+                    self.tracer.record(
+                        "decode_step", t_disp, t_got, cat="serve",
+                        args={"rows": len(itls)},
+                    )
+                if itls:
+                    metrics.observe_phase_batch(
+                        "decode_step", itls, self._layout, t_got
+                    )
+                    for dt in itls:
+                        metrics.itl.observe(dt)
+            for dt in ttfts:
+                metrics.ttft.observe(dt)
+            if n_tokens:
+                metrics.tokens.inc(n_tokens)
+                if metrics.windowed:
+                    metrics.tokens_w.add(float(n_tokens), t_got)
+            if finished:
+                self._resolve(finished, t_got)
+
+    def close(self, drain: bool = True, join_timeout_s: float = 30.0) -> None:
+        """Stop the decode loop. ``drain=True`` admits + finishes what's
+        queued first; otherwise queued futures fail (in-flight sequences
+        still run to completion — their slots empty the table, which is
+        what lets the loop exit)."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    p = self._queue.popleft()
+                    p.future.set_exception(RuntimeError("batcher closed"))
+                self._count = 0
+                self.metrics.queue_depth.set(0)
+            self._cv.notify_all()
+        self._thread.join(timeout=join_timeout_s)
+        self._fetch_thread.join(timeout=join_timeout_s)
+        stuck = [
+            t.name
+            for t in (self._thread, self._fetch_thread)
+            if t.is_alive()
         ]
         if stuck:
             msg = (

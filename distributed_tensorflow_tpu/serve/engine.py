@@ -53,6 +53,7 @@ import numpy as np
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_tensorflow_tpu.models.causal_lm import sample_tokens
 from distributed_tensorflow_tpu.parallel.mesh import (
     batch_sharding,
     build_mesh,
@@ -571,6 +572,396 @@ class BertInferenceEngine(_AotEngine):
                 res["mlm_logits"] = out["mlm_logits"][r, :l]
             results.append(res)
         return results
+
+
+def _make_causal_prefill(model):
+    """Prefill executable body for one (tier, bucket): run the full causal
+    forward, scatter every layer's K/V into the slot cache pages, and
+    sample each row's FIRST generated token on-device.
+
+    Tier padding rows carry slot index == S (one past the pool) so the
+    ``mode="drop"`` scatters write nowhere — padding can never dirty a
+    live slot's pages."""
+
+    def prefill_fn(params, ck, cv, last, ids, mask, slots, lengths, temps,
+                   seeds):
+        logits, k, v = model.apply(
+            {"params": params}, ids, mask, method="prefill"
+        )
+        rows = jnp.arange(ids.shape[0])
+        last_logits = logits[rows, jnp.maximum(lengths, 1) - 1]
+        tok = sample_tokens(last_logits, temps, seeds, lengths)
+        ck = ck.at[:, slots, : ids.shape[1]].set(
+            k.astype(ck.dtype), mode="drop"
+        )
+        cv = cv.at[:, slots, : ids.shape[1]].set(
+            v.astype(cv.dtype), mode="drop"
+        )
+        last = last.at[slots].set(tok, mode="drop")
+        return ck, cv, last, tok
+
+    return prefill_fn
+
+
+def _make_causal_decode(model, cache_len: int):
+    """Decode-step executable body (ONE shape: the full slot table): write
+    each slot's pending token at its position, attend the cache prefix,
+    sample the next token. ``last`` only advances where ``active`` — an
+    idle slot's garbage lanes never reach its state (and its cache writes
+    are dead by construction: every page is re-written by a later prefill
+    or decode before anything reads it)."""
+
+    def decode_fn(params, ck, cv, last, lengths, active, temps, seeds):
+        pos = jnp.minimum(lengths, cache_len - 1)
+        logits, ck, cv = model.apply(
+            {"params": params}, last, pos, ck, cv, method="decode_step"
+        )
+        tok = sample_tokens(logits, temps, seeds, lengths + 1)
+        last = jnp.where(active, tok, last)
+        return ck, cv, last, tok
+
+    return decode_fn
+
+
+class CausalLMEngine(_AotEngine):
+    """Autoregressive generation over a trained :class:`CausalLM` checkpoint
+    with a paged, slot-addressed KV cache.
+
+    The cache is a FIXED pool of per-slot pages — ``k/v: [num_layers,
+    slots, cache_len, heads, head_dim]`` plus a ``last_token [slots]``
+    vector — living on device for the engine's lifetime and threaded
+    functionally through every executable with buffer donation, so each
+    step updates the pool in place and slot assignment/reuse never changes
+    a shape (= never recompiles, the decode analog of the tier grid's
+    "startup pays every compile" rule). The AOT grid is:
+
+    - ``prefill`` per (batch tier x prompt bucket): the full causal
+      forward + a scatter of the prompt's K/V into the admitted rows'
+      pages + on-device sampling of each row's first token (the
+      time-to-first-token reply needs exactly that one small fetch).
+    - ``decode`` — ONE executable at the full slot-table shape: every
+      step embeds each slot's pending token, extends its pages, samples
+      the next token. Idle slots ride along masked; the batcher admits /
+      frees between steps without ever touching a compiled shape.
+
+    ``last_token`` stays device-resident, so step k+1 dispatches against
+    step k's un-fetched output — the host fetch of sampled tokens (finish
+    detection, streaming) overlaps the next step's device compute via the
+    batcher's completion thread.
+
+    Sampling is greedy at ``temperature == 0`` and seeded-categorical
+    otherwise, keyed on (seed, absolute position) only — a request's token
+    stream is a function of the request, not of its batchmates, so
+    continuous batching is bit-identical to a solo run.
+
+    Tensor parallelism (a mesh with a ``model`` axis) shards the head axis
+    of the cache pages and the params per ``causal_param_specs``; batch
+    inputs replicate (every model shard sees every slot — slot state must
+    stay coherent, and decode batches are tiny). Expert/pipeline axes are
+    rejected at startup. DP axes likewise replicate: a decode engine is
+    one replica; fleet scale-out is N engines behind the router contract.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        mesh=None,
+        *,
+        buckets: tuple[int, ...] = (64, 128, 256),
+        slots: int = 8,
+        max_batch: int = 4,
+        batch_tiers: tuple[int, ...] | None = None,
+        max_new_tokens: int = 32,
+    ):
+        if slots < 1:
+            raise ValueError(f"need at least one cache slot, got {slots}")
+        super().__init__(mesh, min(max_batch, slots), batch_tiers)
+        tp = self.mesh.shape.get("model", 1)
+        ep = self.mesh.shape.get("expert", 1)
+        pp = self.mesh.shape.get("pipeline", 1)
+        self._model_sharded = tp > 1
+        serve_cfg = self._serve_config(model.cfg, tp=tp, ep=ep, pp=pp)
+        self.model = (
+            type(model)(serve_cfg) if serve_cfg is not model.cfg else model
+        )
+        cfg = self.model.cfg
+        self.slots = slots
+        self.buckets = tuple(
+            sorted({min(int(b), cfg.max_position) for b in buckets})
+        )
+        if not self.buckets:
+            raise ValueError("need at least one prompt bucket")
+        # Every slot's pages hold prompt + generated tokens; validate()
+        # rejects requests that could not fit before they ever enqueue.
+        self.cache_len = min(self.buckets[-1] + max_new_tokens,
+                             cfg.max_position)
+        self.max_new_tokens = max_new_tokens
+
+        from distributed_tensorflow_tpu.models.causal_lm import (
+            causal_param_specs,
+        )
+
+        cache_shape = (
+            cfg.num_layers, slots, self.cache_len,
+            cfg.num_heads, cfg.hidden_size // cfg.num_heads,
+        )
+        if self._model_sharded:
+            self._param_specs = causal_param_specs(params, model_axis="model")
+            self._param_sharding = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s),
+                self._param_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            self._cache_spec = P(None, None, None, "model", None)
+        else:
+            self._param_specs = None
+            self._cache_spec = P()
+        self._cache_sharding = NamedSharding(self.mesh, self._cache_spec)
+        self._rep = replicated_sharding(self.mesh)
+        self.params = self._place(params)
+        self._cache_k = jax.device_put(
+            jnp.zeros(cache_shape, cfg.dtype), self._cache_sharding
+        )
+        self._cache_v = jax.device_put(
+            jnp.zeros(cache_shape, cfg.dtype), self._cache_sharding
+        )
+        self._last_token = jax.device_put(
+            jnp.zeros((slots,), jnp.int32), self._rep
+        )
+
+        # The grid: prefill per (tier x bucket) + ONE decode step. Cache /
+        # last_token operands are donated — XLA updates the pool in place,
+        # and the engine swaps its refs for the returned ones at dispatch.
+        self._prefill_compiled = {}
+        for T in self.batch_tiers:
+            fn = self._wrap(_make_causal_prefill(self.model), n_batch=6)
+            for L in self.buckets:
+                self._prefill_compiled[T, L] = (
+                    jax.jit(fn, donate_argnums=(1, 2, 3))
+                    .lower(
+                        self.params,
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._rep_struct((slots,), jnp.int32),
+                        self._rep_struct((T, L), jnp.int32),
+                        self._rep_struct((T, L), jnp.bool_),
+                        self._rep_struct((T,), jnp.int32),
+                        self._rep_struct((T,), jnp.int32),
+                        self._rep_struct((T,), jnp.float32),
+                        self._rep_struct((T,), jnp.int32),
+                    )
+                    .compile()
+                )
+        decode_fn = self._wrap(
+            _make_causal_decode(self.model, self.cache_len), n_batch=4
+        )
+        self._decode_compiled = (
+            jax.jit(decode_fn, donate_argnums=(1, 2, 3))
+            .lower(
+                self.params,
+                self._cache_struct(cache_shape, cfg.dtype),
+                self._cache_struct(cache_shape, cfg.dtype),
+                self._rep_struct((slots,), jnp.int32),
+                self._rep_struct((slots,), jnp.int32),
+                self._rep_struct((slots,), jnp.bool_),
+                self._rep_struct((slots,), jnp.float32),
+                self._rep_struct((slots,), jnp.int32),
+            )
+            .compile()
+        )
+        logger.info(
+            "causal-LM engine ready: layout=%s slots=%d cache_len=%d "
+            "buckets=%s tiers=%s (%d executables)",
+            self.layout, slots, self.cache_len, self.buckets,
+            self.batch_tiers, len(self._prefill_compiled) + 1,
+        )
+
+    @staticmethod
+    def _serve_config(cfg, tp: int = 1, ep: int = 1, pp: int = 1):
+        """Bind the decode model to the mesh's model axes — TP only. The
+        slot cache has no expert routing and a pipelined decode step would
+        bubble ~(pp-1)/pp of every token; both reject loudly at startup so
+        shardcheck's sweep (SC002) sees a clean plan/serve/reject story."""
+        if ep > 1:
+            raise ValueError(
+                f"expert axis of {ep}: the decode engine does not support "
+                "expert parallelism (no MoE decoder variant)"
+            )
+        if pp > 1:
+            raise ValueError(
+                f"pipeline axis of {pp}: the decode engine does not support "
+                "pipeline parallelism (a one-token step cannot fill a "
+                "GPipe schedule)"
+            )
+        if tp > 1:
+            if cfg.num_heads % tp or cfg.intermediate_size % tp:
+                raise ValueError(
+                    f"model axis of {tp} must divide num_heads "
+                    f"({cfg.num_heads}) and intermediate_size "
+                    f"({cfg.intermediate_size})"
+                )
+            cfg = dataclasses.replace(
+                cfg, model_axis="model", model_parallel=tp
+            )
+        return cfg
+
+    def _cache_struct(self, shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=self._cache_sharding)
+
+    def _rep_struct(self, shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=self._rep)
+
+    def _wrap(self, fn, n_batch: int):
+        """shard_map the step over the model axis when sharded; the cache's
+        head axis splits, everything batch-like replicates (post-psum
+        logits are identical across shards, so replicated outs are safe)."""
+        if not self._model_sharded:
+            return fn
+        cache, rep = self._cache_spec, P()
+        # (params, cache_k, cache_v, last) + the n_batch step operands.
+        in_specs = (self._param_specs, cache, cache, rep) + (rep,) * n_batch
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=(cache, cache, rep, rep),
+            check_vma=False,
+        )
+
+    # -- request surface ------------------------------------------------
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise RequestError(
+            f"prompt length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+    def validate(self, payload: dict) -> None:
+        ids = np.asarray(payload.get("input_ids", ()))
+        if ids.ndim != 1 or ids.size == 0:
+            raise RequestError("input_ids must be a non-empty 1-D id list")
+        self.bucket_for(ids.shape[0])
+        max_new = int(payload.get("max_new_tokens", self.max_new_tokens))
+        if max_new < 1:
+            raise RequestError("max_new_tokens must be >= 1")
+        if ids.shape[0] + max_new > self.cache_len:
+            raise RequestError(
+                f"prompt of {ids.shape[0]} + max_new_tokens {max_new} "
+                f"exceeds the {self.cache_len}-token cache pages"
+            )
+        if float(payload.get("temperature", 0.0)) < 0.0:
+            raise RequestError("temperature must be >= 0")
+
+    def request_bucket(self, payload: dict) -> int:
+        return self.bucket_for(np.asarray(payload["input_ids"]).shape[0])
+
+    # -- the two dispatch points (decode-loop thread only: both swap the
+    # -- engine's device-state refs, which is single-writer by contract) --
+
+    def prefill(self, admissions: list[dict]) -> InFlightBatch:
+        """Admit up to a tier of requests into their assigned slots.
+
+        ``admissions`` rows: ``{"slot", "input_ids", "temperature",
+        "seed"}``. Returns without blocking; ``fetch_step`` yields the
+        [tier]-shaped first-token vector (real rows = admitted order)."""
+        if len(admissions) > self.max_batch:
+            raise ValueError(
+                f"admitting {len(admissions)} exceeds max_batch "
+                f"{self.max_batch}"
+            )
+        lens = [np.asarray(a["input_ids"]).shape[0] for a in admissions]
+        L = self.bucket_for(max(lens))
+        T = self.tier_for(len(admissions))
+        key = ("prefill", T, L)
+
+        def _make():
+            return (
+                np.zeros((T, L), np.int32),
+                np.zeros((T, L), bool),
+                np.full((T,), self.slots, np.int32),
+                np.zeros((T,), np.int32),
+                np.zeros((T,), np.float32),
+                np.zeros((T,), np.int32),
+            )
+
+        ids, mask, slot_ix, lengths, temps, seeds = buffers = (
+            self._take_buffers(key, _make)
+        )
+        ids.fill(0)
+        mask.fill(False)
+        slot_ix.fill(self.slots)  # out-of-pool: padding rows scatter-drop
+        lengths.fill(0)
+        temps.fill(0.0)
+        seeds.fill(0)
+        for r, (a, l) in enumerate(zip(admissions, lens)):
+            ids[r, :l] = np.asarray(a["input_ids"], np.int32)
+            mask[r, :l] = True
+            slot_ix[r] = int(a["slot"])
+            lengths[r] = l
+            temps[r] = float(a.get("temperature", 0.0))
+            seeds[r] = int(a.get("seed", 0))
+        mask[len(admissions):, 0] = True
+        t_assembled = time.monotonic()
+        ck, cv, last, tok = self._prefill_compiled[T, L](
+            self.params, self._cache_k, self._cache_v, self._last_token,
+            jax.device_put(ids, self._rep), jax.device_put(mask, self._rep),
+            jax.device_put(slot_ix, self._rep),
+            jax.device_put(lengths, self._rep),
+            jax.device_put(temps, self._rep),
+            jax.device_put(seeds, self._rep),
+        )
+        self._cache_k, self._cache_v, self._last_token = ck, cv, last
+        self._record_dispatch(T, L, len(admissions))
+        return InFlightBatch(
+            out={"tok": tok}, key=key, n=len(admissions),
+            meta=[int(s) for s in slot_ix[: len(admissions)]],
+            buffers=buffers, layout=self.layout, t_assembled=t_assembled,
+        )
+
+    def decode(self, lengths, active, temps, seeds) -> InFlightBatch:
+        """Dispatch ONE decode step over the full slot table (host arrays
+        are snapshots; the batcher advances its lengths at dispatch so
+        steps pipeline). Returns without blocking."""
+        key = ("decode",)
+
+        def _make():
+            s = self.slots
+            return (
+                np.zeros((s,), np.int32),
+                np.zeros((s,), bool),
+                np.zeros((s,), np.float32),
+                np.zeros((s,), np.int32),
+            )
+
+        blen, bact, btmp, bseed = buffers = self._take_buffers(key, _make)
+        np.copyto(blen, lengths)
+        np.copyto(bact, active)
+        np.copyto(btmp, temps)
+        np.copyto(bseed, seeds)
+        t_assembled = time.monotonic()
+        ck, cv, last, tok = self._decode_compiled(
+            self.params, self._cache_k, self._cache_v, self._last_token,
+            jax.device_put(blen, self._rep), jax.device_put(bact, self._rep),
+            jax.device_put(btmp, self._rep), jax.device_put(bseed, self._rep),
+        )
+        self._cache_k, self._cache_v, self._last_token = ck, cv, last
+        return InFlightBatch(
+            out={"tok": tok}, key=key, n=int(np.sum(bact)), meta=None,
+            buffers=buffers, layout=self.layout, t_assembled=t_assembled,
+        )
+
+    def fetch_step(self, inflight: InFlightBatch) -> np.ndarray:
+        """Block on a step's sampled-token vector (the ONLY device_get on
+        the decode path — everything else stays resident)."""
+        tok = np.asarray(jax.device_get(inflight.out["tok"]))
+        inflight.t_got = time.monotonic()
+        self._give_buffers(inflight.key, inflight.buffers)
+        return tok
 
 
 class ImageClassifierEngine(_AotEngine):

@@ -30,6 +30,9 @@ Routes::
     POST /v1/mlm     -> BERT: pred_ids / score / nsp_probs for one example
     POST /v1/embed   -> BERT: pooled [CLS] embedding for one example
     POST /v1/classify-> image: top-k ids/probs for one example
+    POST /v1/generate-> causal LM: generated tokens for one prompt
+                        (continuous batching: the request joins the
+                        in-flight decode batch between steps)
 
 Every request gets a ``request_id`` (honoring an ``X-Request-Id`` header
 when the client sends one) that rides through the batcher into the engine
@@ -64,6 +67,7 @@ from distributed_tensorflow_tpu.obs.timeseries import bounds_with
 from distributed_tensorflow_tpu.obs.trace import Tracer
 from distributed_tensorflow_tpu.serve.batcher import (
     BatcherConfig,
+    ContinuousBatcher,
     DynamicBatcher,
 )
 from distributed_tensorflow_tpu.serve.engine import RequestError
@@ -89,6 +93,7 @@ class Client:
         metrics: ServeMetrics | None = None,
         tracer: Tracer | None = None,
         slo: SloSpec | None = None,
+        admission: str = "continuous",
     ):
         self.engine = engine
         if metrics is None:
@@ -108,28 +113,42 @@ class Client:
         # Engines that expose the split hot path (dispatch/fetch) get the
         # overlapped batcher; engines that expose a bucket key get
         # bucket-aware queues when the config asks for them. Stub engines
-        # with only run_batch keep the classic serial path.
+        # with only run_batch keep the classic serial path. Decode engines
+        # (prefill + per-step decode over a slot table) get the
+        # continuous batcher — ``admission`` picks continuous vs the
+        # flush-batching baseline, and bucket_queues is moot (admission
+        # groups are tiny and pad per-group, not per-flush).
         if getattr(engine, "metrics", False) is None:
             engine.metrics = self.metrics  # per-tier/bucket instruments
-        bucket_for = (
-            getattr(engine, "request_bucket", None)
-            if config.bucket_queues
-            else None
-        )
-        if config.bucket_queues and bucket_for is None:
-            raise ValueError(
-                "bucket_queues=True needs an engine with request_bucket()"
+        if hasattr(engine, "prefill") and hasattr(engine, "decode"):
+            self.batcher = ContinuousBatcher(
+                engine,
+                config,
+                metrics=self.metrics,
+                admission=admission,
+                tracer=self.tracer,
+                layout=getattr(engine, "layout", ""),
             )
-        self.batcher = DynamicBatcher(
-            engine.run_batch,
-            config,
-            metrics=self.metrics,
-            dispatch=getattr(engine, "dispatch", None),
-            fetch=getattr(engine, "fetch", None),
-            bucket_for=bucket_for,
-            tracer=self.tracer,
-            layout=getattr(engine, "layout", ""),
-        )
+        else:
+            bucket_for = (
+                getattr(engine, "request_bucket", None)
+                if config.bucket_queues
+                else None
+            )
+            if config.bucket_queues and bucket_for is None:
+                raise ValueError(
+                    "bucket_queues=True needs an engine with request_bucket()"
+                )
+            self.batcher = DynamicBatcher(
+                engine.run_batch,
+                config,
+                metrics=self.metrics,
+                dispatch=getattr(engine, "dispatch", None),
+                fetch=getattr(engine, "fetch", None),
+                bucket_for=bucket_for,
+                tracer=self.tracer,
+                layout=getattr(engine, "layout", ""),
+            )
         # SLO + readiness: the tracker reads the windowed families and the
         # batcher's live status at probe time — no thread, nothing to join.
         self.slo = SloTracker(self.metrics, slo or SloSpec())
@@ -212,6 +231,7 @@ def build_http_server(
             "/v1/mlm": ("pred_ids", "score", "nsp_probs", "bucket"),
             "/v1/embed": ("embedding", "bucket"),
             "/v1/classify": ("top_ids", "top_probs"),
+            "/v1/generate": ("tokens", "n_tokens", "prompt_len", "bucket"),
         }
 
         def log_message(self, fmt, *args):  # route access logs into logging
@@ -244,6 +264,9 @@ def build_http_server(
                 # Mesh topology digest: layout label, axis sizes, devices
                 # one batch spans (None for stub engines without a mesh).
                 "mesh": mesh_info() if callable(mesh_info) else None,
+                # Batching mode (flush vs continuous) + slot occupancy for
+                # decode engines — the router contract's generative fields.
+                "batcher": client.batcher.status(),
                 "queue_depth": snap["queue_depth"],
                 "in_flight": snap["in_flight"],
                 "requests": snap["requests"],
@@ -370,6 +393,20 @@ def build_http_server(
             else:
                 body = {k: result[k] for k in fields if k in result}
                 body["request_id"] = rid
+                # Which batching served this (flush vs continuous) + slot
+                # occupancy on decode replicas — one consistent status read.
+                st = client.batcher.status()
+                body["batching"] = {
+                    "mode": st["mode"],
+                    **(
+                        {
+                            "slots": st["slots"],
+                            "slots_active": st["slots_active"],
+                        }
+                        if "slots" in st
+                        else {}
+                    ),
+                }
                 phases = getattr(fut, "phases", None)
                 if phases is not None:
                     body["phases"] = {

@@ -35,6 +35,28 @@ baseline layout exits nonzero (the CI regression tripwire).
 
     python scripts/serve_bench.py --mesh-layouts single dp tp2 tp4
     python scripts/serve_bench.py --quick --mesh-layouts single tp2
+
+Decode mode (--decode) replaces the load sweep with the continuous-batching
+A/B: a mixed prompt-length/output-length generation workload runs twice
+through the SAME slot-table batcher — once with continuous admission
+(requests join the in-flight decode batch as slots free) and once with
+``admission="flush"`` (the static-batching baseline: admit only into an
+empty table). The engine is a simulated-step stub whose per-step device
+cost is fixed (``--sim-step-ms``) and whose token streams are closed-form
+functions of the prompt, so the A/B is deterministic on CPU, isolates the
+SCHEDULING policy, and cannot trade correctness for speed — every stream
+is checked. Each mode reports a saturated closed-loop backlog drain
+(tokens/s, TTFT, ITL, mean live slots per step) and one open-loop point at
+``--loads[0]`` requests/s. Before the A/B, a real (tiny) causal-LM engine
+decodes a mixed backlog and every token stream must match a cache-free
+full-forward greedy reference. Under ``--quick`` the run exits nonzero on
+a parity/stream mismatch, phase-sum divergence >25%, continuous tokens/s
+below 1.5x flush, or continuous TTFT p50 above flush (the CI gate the
+docs/PERF.md round-11 numbers are recorded from).
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py --decode
+    python scripts/serve_bench.py --decode --slots 16 --sim-step-ms 5
+    python scripts/serve_bench.py --decode --quick   # CI gate (~seconds)
 """
 
 from __future__ import annotations
@@ -218,6 +240,394 @@ def run_load(client, payloads, offered_rps: float, duration_s: float) -> dict:
         "wall_s": t_end - t0,
         "_exact_latency_s": exact,
     }
+
+
+# ----------------------------------------------------------- decode mode
+
+
+class SimStepEngine:
+    """Pure-python decode engine with a FIXED per-step device cost.
+
+    Token k of a request is a closed-form function of (prompt, k), so any
+    admission schedule — solo, joined mid-flight, after slot reuse — must
+    deliver identical streams; the A/B cannot trade correctness for speed.
+    Every dispatched step (prefill or decode) burns ``step_ms`` of wall
+    clock in ``fetch_step`` regardless of how many slots are live — the
+    device-cost model under which continuous batching pays: a step over a
+    mostly-idle slot table costs the same as over a full one, so tokens/s
+    is proportional to mean occupancy and the A/B flips ONLY the
+    admission policy.
+    """
+
+    layout = "sim-step"
+
+    def __init__(self, *, slots: int, max_batch: int, max_new_tokens: int,
+                 step_ms: float):
+        import threading
+
+        self.slots = slots
+        self.max_batch = max_batch
+        self.max_new_tokens = max_new_tokens
+        self.step_s = step_ms / 1e3
+        self._lock = threading.Lock()
+        # slot -> (prompt_sum, steps_taken); written only by the decode-loop
+        # thread (the batcher's single-dispatcher contract), read by its
+        # fetch thread. Never cleared on finish — the real engine's cache
+        # pages aren't either, the next occupant overwrites them.
+        self._state: dict[int, tuple[int, int]] = {}
+
+    @staticmethod
+    def token(prompt_sum: int, k: int) -> int:
+        return (prompt_sum + 7 * k) % 50 + 5
+
+    def validate(self, payload: dict) -> None:
+        pass
+
+    def bucket_for(self, n: int) -> int:
+        for b in (32, 64, 128):
+            if n <= b:
+                return b
+        return 128
+
+    def prefill(self, admissions: list[dict]):
+        with self._lock:
+            toks = []
+            for a in admissions:
+                psum = int(np.sum(a["input_ids"]))
+                self._state[a["slot"]] = (psum, 1)
+                toks.append(self.token(psum, 0))
+        return toks
+
+    def decode(self, lengths, active, temps, seeds):
+        with self._lock:
+            toks = np.zeros(self.slots, np.int64)
+            for slot, is_active in enumerate(active):
+                if is_active and slot in self._state:
+                    psum, k = self._state[slot]
+                    toks[slot] = self.token(psum, k)
+                    self._state[slot] = (psum, k + 1)
+        return toks
+
+    def fetch_step(self, handle):
+        time.sleep(self.step_s)  # the simulated device step
+        return np.asarray(handle)
+
+
+def make_decode_payloads(n: int, *, max_new: int, vocab: int = 512,
+                         seed: int = 0) -> list[dict]:
+    """Mixed-length generation pool: prompts 4..32 tokens; output budgets
+    are heavy-tailed — 3/4 short turns of 2..max_new/4 tokens, 1/4 long
+    generations of 3/4*max_new..max_new — the length mix real decode
+    traffic shows and the one static flush-batching is worst at: every
+    flush batch lasts as long as its LONGEST member, so one long
+    generation strands the seven finished slots beside it."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        plen = int(rng.integers(4, 33))
+        if rng.random() < 0.75:
+            budget = int(rng.integers(2, max(3, max_new // 4)))
+        else:
+            budget = int(rng.integers(max(2, 3 * max_new // 4), max_new + 1))
+        out.append({
+            "input_ids": rng.integers(5, vocab, size=plen),
+            "max_new_tokens": budget,
+        })
+    return out
+
+
+def _sim_expected(payload: dict) -> list[int]:
+    psum = int(np.sum(payload["input_ids"]))
+    return [
+        SimStepEngine.token(psum, k)
+        for k in range(payload["max_new_tokens"])
+    ]
+
+
+def _decode_parity_probe(n_requests: int) -> tuple[bool, float]:
+    """Numerics tripwire ahead of the sim A/B: a real (tiny) causal-LM
+    engine decodes a mixed backlog through the continuous batcher — more
+    requests than slots, so admissions join mid-flight — and every token
+    stream must equal a cache-free full-forward greedy reference. Returns
+    ``(parity_ok, max_phase_divergence)`` with the divergence measured on
+    the REAL engine's phase spans (queue_wait/prefill/decode vs wall)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        CausalLMEngine,
+        Client,
+    )
+
+    cfg = CausalLMConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, max_position=48,
+    )
+    model = CausalLM(cfg)
+    L = cfg.max_position
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+    )["params"]
+    engine = CausalLMEngine(
+        model, params, buckets=(8, 16), slots=3, max_batch=2,
+        max_new_tokens=8,
+    )
+
+    rng = np.random.default_rng(7)
+    reqs = []
+    for _ in range(n_requests):
+        plen = int(rng.integers(3, 14))
+        reqs.append({
+            "input_ids": rng.integers(5, cfg.vocab_size, size=plen),
+            "max_new_tokens": int(rng.integers(2, 9)),
+        })
+    refs = []
+    for r in reqs:
+        toks = [int(t) for t in r["input_ids"]]
+        out = []
+        for _ in range(r["max_new_tokens"]):
+            x = jnp.asarray([toks], jnp.int32)
+            logits = model.apply(
+                {"params": params}, x, jnp.ones((1, len(toks)), bool)
+            )
+            nxt = int(jnp.argmax(logits[0, -1]))
+            out.append(nxt)
+            toks.append(nxt)
+        refs.append(out)
+
+    with Client(engine, BatcherConfig(max_batch=2)) as client:
+        futs = [client.submit(dict(r)) for r in reqs]
+        results = [f.result(timeout=300) for f in futs]
+    ok, max_div = True, 0.0
+    for r, ref, f in zip(results, refs, futs):
+        if r["tokens"] != ref:
+            ok = False
+            print(f"# parity mismatch: got {r['tokens']} want {ref}",
+                  file=sys.stderr)
+        if f.latency_s:
+            max_div = max(
+                max_div,
+                abs(sum(f.phases.values()) - f.latency_s) / f.latency_s,
+            )
+    return ok, max_div
+
+
+def _run_decode_point(args, admission: str, payloads: list[dict],
+                      open_rps: float) -> dict:
+    """One arm of the A/B: fresh sim engine + batcher in the given
+    admission mode, a saturated closed-loop backlog drain, then one
+    open-loop offered-load point. Token streams are checked against the
+    closed form; phase sums are checked against wall latency."""
+    from distributed_tensorflow_tpu.serve import BatcherConfig, Client
+
+    eng = SimStepEngine(
+        slots=args.slots, max_batch=args.max_batch,
+        max_new_tokens=args.max_new_tokens, step_ms=args.sim_step_ms,
+    )
+    client = Client(
+        eng,
+        BatcherConfig(
+            max_batch=args.max_batch, max_queue=args.max_queue,
+            max_in_flight=args.max_in_flight,
+            max_delay_ms=args.max_delay_ms,
+        ),
+        admission=admission,
+    )
+    m = client.metrics
+    mismatched, max_div = 0, 0.0
+    try:
+        client.call(payloads[0], timeout=120)  # warm the thread machinery
+        # ------- closed loop: saturated backlog drain (peak tokens/s)
+        m.ttft.reset()
+        m.itl.reset()
+        steps0 = m.decode_steps.value
+        t0 = time.monotonic()
+        futs = [client.submit(dict(p)) for p in payloads]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.monotonic() - t0
+        for p, f, r in zip(payloads, futs, results):
+            if r["tokens"] != _sim_expected(p):
+                mismatched += 1
+            if f.latency_s:
+                max_div = max(
+                    max_div,
+                    abs(sum(f.phases.values()) - f.latency_s) / f.latency_s,
+                )
+        toks = sum(r["n_tokens"] for r in results)
+        steps = m.decode_steps.value - steps0
+        snap = m.snapshot()
+        backlog = {
+            "requests": len(results),
+            "tokens": toks,
+            "wall_s": wall,
+            "tokens_per_s": toks / wall,
+            "ttft_p50_ms": snap["ttft_ms"]["p50"],
+            "ttft_p99_ms": snap["ttft_ms"]["p99"],
+            "itl_p50_ms": snap["itl_ms"]["p50"],
+            "itl_p99_ms": snap["itl_ms"]["p99"],
+            "decode_steps": steps,
+            # decode-fetched tokens per step = how full the table ran
+            # (prefill delivers each request's first token).
+            "mean_live_slots": (toks - len(results)) / steps if steps else 0.0,
+        }
+        # ------- open loop: fixed offered request schedule
+        m.ttft.reset()
+        m.itl.reset()
+        tokens0 = m.tokens.value
+        load = run_load(client, payloads, open_rps, args.duration)
+        load.pop("_exact_latency_s", None)
+        snap = m.snapshot()
+        open_row = {
+            "offered_rps": load["offered_rps"],
+            "submitted": load["submitted"],
+            "served": load["served"],
+            "rejected": load["rejected"],
+            "achieved_rps": load["achieved_rps"],
+            "tokens_per_s": (m.tokens.value - tokens0) / load["wall_s"],
+            "ttft_p50_ms": snap["ttft_ms"]["p50"],
+            "ttft_p99_ms": snap["ttft_ms"]["p99"],
+            "itl_p50_ms": snap["itl_ms"]["p50"],
+        }
+    finally:
+        client.close()
+    return {
+        "admission": admission,
+        "backlog": backlog,
+        "open_loop": open_row,
+        "mismatched_streams": mismatched,
+        "max_phase_divergence": max_div,
+    }
+
+
+def run_decode(args) -> int:
+    """The continuous-batching decode A/B (--decode)."""
+    payloads = make_decode_payloads(
+        args.decode_requests, max_new=args.max_new_tokens, vocab=args.vocab
+    )
+    open_rps = args.loads[0]
+
+    print("# decode parity probe: real tiny causal-LM engine, greedy, "
+          "mid-flight admissions vs full-forward reference")
+    parity_ok, parity_div = _decode_parity_probe(3 if args.quick else 6)
+    print(f"# parity {'ok' if parity_ok else 'FAIL'}, real-engine phase "
+          f"divergence {100 * parity_div:.1f}%")
+
+    rows = {}
+    for admission in ("continuous", "flush"):
+        rows[admission] = _run_decode_point(
+            args, admission, payloads, open_rps
+        )
+
+    hdr = (
+        f"{'admission':>11} {'tok/s':>8} {'ttft p50':>9} {'ttft p99':>9} "
+        f"{'itl p50':>8} {'itl p99':>8} {'steps':>6} {'live/step':>9} "
+        f"{'wall s':>7}"
+    )
+    print(f"\nbacklog drain ({args.decode_requests} mixed requests, "
+          f"{args.slots} slots, {args.sim_step_ms:g} ms/step):")
+    print(hdr)
+    print("-" * len(hdr))
+    for name, r in rows.items():
+        b = r["backlog"]
+        print(
+            f"{name:>11} {b['tokens_per_s']:>8.0f} "
+            f"{b['ttft_p50_ms']:>9.1f} {b['ttft_p99_ms']:>9.1f} "
+            f"{b['itl_p50_ms']:>8.2f} {b['itl_p99_ms']:>8.2f} "
+            f"{b['decode_steps']:>6d} {b['mean_live_slots']:>9.2f} "
+            f"{b['wall_s']:>7.2f}"
+        )
+    print(f"\nopen loop ({open_rps:g} req/s offered, "
+          f"{args.duration:g}s):")
+    hdr = (
+        f"{'admission':>11} {'tok/s':>8} {'achieved rps':>13} "
+        f"{'rejected':>9} {'ttft p50':>9} {'ttft p99':>9} {'itl p50':>8}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for name, r in rows.items():
+        o = r["open_loop"]
+        print(
+            f"{name:>11} {o['tokens_per_s']:>8.0f} "
+            f"{o['achieved_rps']:>13.1f} {o['rejected']:>9d} "
+            f"{o['ttft_p50_ms']:>9.1f} {o['ttft_p99_ms']:>9.1f} "
+            f"{o['itl_p50_ms']:>8.2f}"
+        )
+
+    cont, flsh = rows["continuous"], rows["flush"]
+    speedup = (
+        cont["backlog"]["tokens_per_s"] / flsh["backlog"]["tokens_per_s"]
+        if flsh["backlog"]["tokens_per_s"] else float("inf")
+    )
+    ttft_ratio = (
+        cont["backlog"]["ttft_p50_ms"] / flsh["backlog"]["ttft_p50_ms"]
+        if flsh["backlog"]["ttft_p50_ms"] else 1.0
+    )
+    max_div = max(
+        parity_div,
+        cont["max_phase_divergence"],
+        flsh["max_phase_divergence"],
+    )
+    mismatched = cont["mismatched_streams"] + flsh["mismatched_streams"]
+    print(
+        f"\ncontinuous vs flush: {speedup:.2f}x tokens/s, "
+        f"ttft p50 {ttft_ratio:.2f}x, max phase divergence "
+        f"{100 * max_div:.1f}%"
+    )
+
+    if args.json:
+        report = {
+            "mode": "decode",
+            "config": {
+                "slots": args.slots,
+                "max_batch": args.max_batch,
+                "max_in_flight": args.max_in_flight,
+                "max_new_tokens": args.max_new_tokens,
+                "sim_step_ms": args.sim_step_ms,
+                "decode_requests": args.decode_requests,
+                "open_rps": open_rps,
+            },
+            "parity_ok": parity_ok,
+            "ab": rows,
+            "speedup_tokens_per_s": speedup,
+            "ttft_p50_ratio": ttft_ratio,
+            "max_phase_divergence": max_div,
+        }
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+        print(f"# wrote {args.json}")
+
+    # Correctness is unconditional; the perf thresholds are the --quick CI
+    # gate (the same numbers docs/PERF.md round 11 records from a full run).
+    if not parity_ok:
+        print("FAIL: real-engine greedy decode diverged from the "
+              "full-forward reference", file=sys.stderr)
+        return 1
+    if mismatched:
+        print(f"FAIL: {mismatched} sim token streams misrouted by the "
+              "slot-table scheduler", file=sys.stderr)
+        return 1
+    if args.quick:
+        if max_div > 0.25:
+            print(f"FAIL: phase spans diverge {100 * max_div:.1f}% from "
+                  "wall latency (>25%)", file=sys.stderr)
+            return 1
+        if speedup < 1.5:
+            print(f"FAIL: continuous batching {speedup:.2f}x flush "
+                  "tokens/s (<1.5x) — admission is no longer filling "
+                  "freed slots mid-flight", file=sys.stderr)
+            return 1
+        if ttft_ratio > 1.05:
+            print(f"FAIL: continuous TTFT p50 {ttft_ratio:.2f}x flush "
+                  "(>1.05x) — throughput must not come from delaying "
+                  "first tokens", file=sys.stderr)
+            return 1
+    return 0
 
 
 def _parse_layout(name: str) -> dict | None:
@@ -450,6 +860,18 @@ def main(argv=None) -> int:
                    "baseline)")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="MoE expert count for epN layouts (0 = dense FFN)")
+    p.add_argument("--decode", action="store_true",
+                   help="continuous-batching decode A/B (simulated-step "
+                   "engine + real-engine parity probe) instead of the "
+                   "load sweep")
+    p.add_argument("--slots", type=int, default=8,
+                   help="KV-cache slot table size (decode mode)")
+    p.add_argument("--max-new-tokens", type=int, default=64,
+                   help="largest per-request output budget (decode mode)")
+    p.add_argument("--sim-step-ms", type=float, default=2.0,
+                   help="simulated per-step device cost (decode mode)")
+    p.add_argument("--decode-requests", type=int, default=96,
+                   help="backlog size for the closed-loop decode drain")
     p.add_argument("--slo-p99-ms", type=float, default=50.0,
                    help="latency SLO threshold (ms) for the SLO section "
                    "and the --quick SLO-math consistency gate")
@@ -477,7 +899,12 @@ def main(argv=None) -> int:
         args.single_duration = min(args.single_duration, 0.5)
         args.buckets = [16, 32]
         args.layers, args.hidden, args.vocab = 1, 32, 128
+        # Large enough that the end-of-run drain (no queue left to refill
+        # freed slots) doesn't eat the continuous-admission margin.
+        args.decode_requests = min(args.decode_requests, 64)
 
+    if args.decode:
+        return run_decode(args)
     if args.mesh_layouts:
         return run_mesh_compare(args)
 

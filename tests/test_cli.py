@@ -8,13 +8,15 @@ from distributed_tensorflow_tpu.cli import PRESETS, main
 
 
 def test_presets_cover_reference_configs():
-    """The five reference configs (BASELINE.json) map 1:1 onto presets."""
+    """The five reference configs (BASELINE.json) map 1:1 onto presets,
+    plus lm_base (decoder-only causal LM, beyond the reference)."""
     assert set(PRESETS) == {
         "mnist_lenet",
         "cifar_resnet20",
         "imagenet_resnet50",
         "imagenet_inception_async",
         "bert_base",
+        "lm_base",
     }
     assert PRESETS["imagenet_inception_async"].mode == "stale"
     assert PRESETS["imagenet_inception_async"].staleness > 0
