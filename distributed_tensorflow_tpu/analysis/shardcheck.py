@@ -56,6 +56,16 @@ _COLLECTIVES = {
     "jax.lax.psum_scatter",
 }
 
+# Prefix-cache / chunked-prefill configurations crossed into every decode
+# cell that serves: (prefix_cache_mb, block_tokens). The 0.0 row is the
+# cache-disabled plan (must stay a no-op, never a reject) and the rest
+# exercise the byte-budget -> page-count arithmetic per TP shard layout.
+PREFIX_CACHE_VARIANTS: tuple[tuple[float, int], ...] = (
+    (0.0, 16),
+    (8.0, 16),
+    (8.0, 32),
+)
+
 # Mesh layouts exercised by tests/test_serve_mesh.py plus the CLI default
 # and the documented fallback probes, as (tp, pp, ep) on 8 devices.
 DEFAULT_LAYOUTS: tuple[tuple[int, int, int], ...] = (
@@ -248,6 +258,55 @@ def run_config_sweep(
             try:
                 engine_cls._serve_config(cfg, tp=tp, ep=ep, pp=pp)
                 cell["outcome"] = "serves"
+                if engine_cls is CausalLMEngine:
+                    # Cross the serving cell with the prefix-cache budget
+                    # arithmetic (serve/kvpool.py + engine page pool): each
+                    # variant must plan a page count or reject with a clean
+                    # ValueError — a budget that only dies when the pool
+                    # tensor is allocated would be a raw XLA OOM on metal.
+                    cell["prefix_cache"] = plans = []
+                    for mb, bt in PREFIX_CACHE_VARIANTS:
+                        try:
+                            n_blocks, bpb = engine_cls._plan_prefix_cache(
+                                cfg, tp=tp, prefix_cache_mb=mb,
+                                block_tokens=bt,
+                            )
+                            plans.append({
+                                "mb": mb, "block_tokens": bt,
+                                "blocks": n_blocks,
+                                "bytes_per_block": bpb,
+                            })
+                        except ValueError as exc:
+                            plans.append({
+                                "mb": mb, "block_tokens": bt,
+                                "rejects": str(exc),
+                            })
+                        except Exception as exc:
+                            findings.append(
+                                Finding(
+                                    check="SC002",
+                                    path=(
+                                        "distributed_tensorflow_tpu/"
+                                        "serve/engine.py"
+                                    ),
+                                    line=0,
+                                    scope=(
+                                        f"{engine_cls.__name__}"
+                                        "._plan_prefix_cache"
+                                    ),
+                                    message=(
+                                        f"prefix-cache plan mb={mb} "
+                                        f"block_tokens={bt} on preset "
+                                        f"'{name}' layout tp={tp} raised "
+                                        f"{type(exc).__name__} instead of "
+                                        f"a clean ValueError: {exc}"
+                                    ),
+                                )
+                            )
+                            plans.append({
+                                "mb": mb, "block_tokens": bt,
+                                "raised": type(exc).__name__,
+                            })
             except ValueError as exc:
                 # Designed loud rejection (clean startup error, no XLA trace).
                 cell["outcome"] = "rejects"

@@ -157,6 +157,9 @@ def build_serving_client(cfg, args):
             max_batch=args.max_batch,
             batch_tiers=tuple(args.batch_tiers),
             max_new_tokens=args.max_new_tokens,
+            prefix_cache_mb=args.prefix_cache_mb,
+            block_tokens=args.block_tokens,
+            prefill_chunk=args.prefill_chunk,
         )
         vocab = pieces["model"].cfg.vocab_size
 
@@ -270,6 +273,21 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--max-new-tokens", type=int, default=32,
                         help="generation cap per request (requests may ask "
                         "for less; also sizes the per-slot cache pages)")
+    parser.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                        help="device bytes (MiB) for the prefix-cache KV "
+                        "page pool; shared prompt heads prefill once and "
+                        "admissions reuse the cached pages (0 disables; "
+                        "see DEPLOY.md \"Prefix-cache KV reuse\")")
+    parser.add_argument("--block-tokens", type=int, default=16,
+                        help="tokens per prefix-cache page; prompts share "
+                        "whole pages only, so smaller blocks match more "
+                        "but index/gather more")
+    parser.add_argument("--prefill-chunk", type=int, default=0,
+                        help="prefill prompts in chunks of at most this "
+                        "many tokens, interleaved with decode steps so "
+                        "long-prompt admission bounds in-flight requests' "
+                        "inter-token latency (0 = monolithic prefill "
+                        "unless --prefix-cache-mb is set)")
     parser.add_argument("--flush-admission", action="store_true",
                         help="admit new requests only when the slot table "
                         "is EMPTY (static batching; the A/B baseline for "

@@ -25,6 +25,15 @@ Three forwards share one param tree:
   ``<= position``. Shapes are fixed by the slot count, so slot
   assignment/reuse never retraces (the "fixed pool of per-slot cache
   pages" contract).
+- ``prefill_chunk(input_ids [B, C], positions [B, C], k_cache, v_cache) ->
+  (logits [B, C, V], k_cache', v_cache')`` — a CHUNK of each row's prompt
+  at arbitrary ABSOLUTE positions against per-row caches ``[nl, B, Lc, h,
+  d]``: write the chunk's K/V at ``positions``, attend the cache causally
+  (each query sees positions ``<= its own``). One method covers both
+  prefix-cache suffix prefill (one chunk starting at ``cached_len``) and
+  fixed-size chunked prefill of long prompts; padding lanes carry the
+  out-of-range sentinel position ``Lc`` so their cache writes drop
+  (``mode="drop"``) while attention/embedding use the clamped position.
 
 Numerics: both attention paths accumulate scores and context in f32 with
 the same masking convention (fully-masked rows -> exactly 0), so a token
@@ -114,6 +123,36 @@ def _cached_attention(q, k_cache, v_cache, position):
     ).astype(q.dtype)
 
 
+def _chunk_attention(q, k_cache, v_cache, position):
+    """Chunk-of-queries attention against per-row caches.
+
+    ``q: [B, C, h, d]``; caches ``[B, Lc, h, d]``; ``position: [B, C]`` —
+    the (clamped) cache index each query was written at; each attends
+    ``<= its own position``. Same f32 score/context accumulation and
+    exactly-0 masking as ``_cached_attention``, so a prompt prefilled in
+    chunks matches the full forward's argmax position-for-position.
+    Cache positions beyond a row's written length hold zeros or a prior
+    occupant's values — finite either way, and their softmax weight is
+    exactly 0 under the causal mask, so they never reach the output.
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bchd,blhd->bhcl", q, k_cache, preferred_element_type=jnp.float32
+    )
+    s = s * scale
+    valid = (
+        jnp.arange(k_cache.shape[1])[None, None, :]
+        <= position[:, :, None]
+    )  # [B, C, Lc]
+    m = valid[:, None, :, :]
+    s = jnp.where(m, s, _MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1) * m
+    return jnp.einsum(
+        "bhcl,blhd->bchd", p.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
 class CausalSelfAttention(nn.Module):
     """The BERT attention block, setup-style so the full and cached paths
     share params. Column-parallel Q/K/V over local heads, row-parallel out
@@ -152,11 +191,38 @@ class CausalSelfAttention(nn.Module):
         return self._finish(x, ctx), k, v
 
     def decode(self, x, k_cache, v_cache, position):
+        # position == Lmax marks an idle lane: its scatter drops (writing
+        # anywhere could corrupt a mid-chunk-prefill slot's pages) and its
+        # attention clamps — the lane's output is garbage nobody reads.
         q, k, v = self.query(x), self.key(x), self.value(x)  # [S, h, d]
         idx = jnp.arange(x.shape[0])
-        k_cache = k_cache.at[idx, position].set(k.astype(k_cache.dtype))
-        v_cache = v_cache.at[idx, position].set(v.astype(v_cache.dtype))
-        ctx = _cached_attention(q, k_cache, v_cache, position)
+        k_cache = k_cache.at[idx, position].set(
+            k.astype(k_cache.dtype), mode="drop"
+        )
+        v_cache = v_cache.at[idx, position].set(
+            v.astype(v_cache.dtype), mode="drop"
+        )
+        ctx = _cached_attention(
+            q, k_cache, v_cache,
+            jnp.minimum(position, k_cache.shape[1] - 1),
+        )
+        return self._finish(x, ctx), k_cache, v_cache
+
+    def prefill_chunk(self, x, positions, k_cache, v_cache):
+        # x [B, C, H]; positions [B, C] absolute (sentinel == Lc on
+        # padding lanes -> the scatter drops); caches [B, Lc, h, d].
+        q, k, v = self.query(x), self.key(x), self.value(x)  # [B, C, h, d]
+        rows = jnp.arange(x.shape[0])[:, None]
+        k_cache = k_cache.at[rows, positions].set(
+            k.astype(k_cache.dtype), mode="drop"
+        )
+        v_cache = v_cache.at[rows, positions].set(
+            v.astype(v_cache.dtype), mode="drop"
+        )
+        ctx = _chunk_attention(
+            q, k_cache, v_cache,
+            jnp.minimum(positions, k_cache.shape[1] - 1),
+        )
         return self._finish(x, ctx), k_cache, v_cache
 
 
@@ -196,6 +262,12 @@ class CausalLmLayer(nn.Module):
     def decode(self, x, k_cache, v_cache, position):
         x, k_cache, v_cache = self.attention.decode(
             x, k_cache, v_cache, position
+        )
+        return self._ffn(x), k_cache, v_cache
+
+    def prefill_chunk(self, x, positions, k_cache, v_cache):
+        x, k_cache, v_cache = self.attention.prefill_chunk(
+            x, positions, k_cache, v_cache
         )
         return self._ffn(x), k_cache, v_cache
 
@@ -260,10 +332,33 @@ class CausalLM(nn.Module):
         return self._head(x), jnp.stack(ks), jnp.stack(vs)
 
     def decode_step(self, token, position, k_cache, v_cache):
-        x = self._embed(token, position)  # [S, H]
+        # Clamp for the position-embedding lookup only; the raw (possibly
+        # idle-lane sentinel) position drives the layers' dropped writes.
+        x = self._embed(
+            token, jnp.minimum(position, self.cfg.max_position - 1)
+        )  # [S, H]
         new_k, new_v = [], []
         for i, layer in enumerate(self.layers):
             x, kc, vc = layer.decode(x, k_cache[i], v_cache[i], position)
+            new_k.append(kc)
+            new_v.append(vc)
+        return self._head(x), jnp.stack(new_k), jnp.stack(new_v)
+
+    def prefill_chunk(self, input_ids, positions, k_cache, v_cache):
+        # Absolute-position chunk prefill against the slot cache: caches
+        # ahead of a row's written length may hold garbage, but the causal
+        # mask gives them exactly-0 weight and every such page is
+        # re-written (by this row's later chunks or decode steps) before
+        # anything attends it — the same dead-store argument decode_step
+        # relies on for slot reuse. Positions are clamped for embedding /
+        # attention; raw (possibly sentinel) positions drive the writes.
+        Lc = k_cache.shape[2]
+        x = self._embed(input_ids, jnp.minimum(positions, Lc - 1))
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            x, kc, vc = layer.prefill_chunk(
+                x, positions, k_cache[i], v_cache[i]
+            )
             new_k.append(kc)
             new_v.append(vc)
         return self._head(x), jnp.stack(new_k), jnp.stack(new_v)

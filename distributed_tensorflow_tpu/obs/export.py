@@ -155,6 +155,18 @@ def serve_families(metrics, slo=None, health=None) -> list[Family]:
         .add(m.decode_steps.value),
         Family("serve_slots_active", "gauge",
                "occupied KV-cache slots").add(m.slots_active.value),
+        Family("serve_prefix_lookups_total", "counter",
+               "admissions that consulted the prefix-cache trie")
+        .add(m.prefix_lookups.value),
+        Family("serve_prefix_hits_total", "counter",
+               "admissions that matched a cached prompt prefix")
+        .add(m.prefix_hits.value),
+        Family("serve_prefix_tokens_saved_total", "counter",
+               "prompt tokens skipped via cached KV pages")
+        .add(m.prefix_tokens_saved.value),
+        Family("serve_kv_pool_bytes", "gauge",
+               "KV bytes held by the prefix-cache block pool")
+        .add(m.kv_pool_bytes.value),
     ]
 
     by_cause = Family("serve_rejected_by_cause_total", "counter",

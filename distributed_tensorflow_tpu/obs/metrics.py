@@ -349,6 +349,14 @@ class ServeMetrics:
         self.slots_active = Gauge()    # occupied KV-cache slots
         self.ttft = Histogram()        # seconds, submit -> first token
         self.itl = Histogram()         # seconds between consecutive tokens
+        # Prefix-cache (serve/kvpool.py) families: admissions that
+        # consulted the trie, the subset that matched a cached head, the
+        # prompt tokens those matches skipped (suffix-only prefill), and
+        # the bytes of KV pages the pool currently holds.
+        self.prefix_lookups = Counter()
+        self.prefix_hits = Counter()
+        self.prefix_tokens_saved = Counter()
+        self.kv_pool_bytes = Gauge()
         # ------------------------------------------------ windowed families
         # (obs/timeseries.py) — the SLO/health layer's inputs.  bad_w
         # counts requests that burned availability budget (backpressure +
@@ -435,6 +443,10 @@ class ServeMetrics:
             "tokens": self.tokens.value,
             "decode_steps": self.decode_steps.value,
             "slots_active": self.slots_active.value,
+            "prefix_lookups": self.prefix_lookups.value,
+            "prefix_hits": self.prefix_hits.value,
+            "prefix_tokens_saved": self.prefix_tokens_saved.value,
+            "kv_pool_bytes": self.kv_pool_bytes.value,
             "ttft_ms": {
                 k: (v * 1e3 if k != "count" else v)
                 for k, v in self.ttft.summary().items()

@@ -12,6 +12,10 @@ training rather than a separate stack:
 - ``batcher.py`` — dynamic micro-batcher: flush on max-batch-size or
   max-delay, bounded queue with explicit backpressure, optional
   per-bucket queues, and up to ``max_in_flight`` overlapped batches.
+- ``kvpool.py``  — prefix-cache bookkeeping for the decode path: a radix
+  trie over prompt-token blocks mapping shared heads to refcounted,
+  LRU-evicted chains of device KV pages (the engine owns the pages, this
+  owns what they mean).
 - ``server.py``  — in-process :class:`Client` plus a stdlib-HTTP front end
   with latency/queue/occupancy metrics (obs/metrics.py ServeMetrics).
 
@@ -31,5 +35,9 @@ from distributed_tensorflow_tpu.serve.engine import (  # noqa: F401
     InFlightBatch,
     RequestError,
     plan_serve_mesh,
+)
+from distributed_tensorflow_tpu.serve.kvpool import (  # noqa: F401
+    KVBlockPool,
+    PrefixMatch,
 )
 from distributed_tensorflow_tpu.serve.server import Client, build_http_server  # noqa: F401

@@ -488,7 +488,7 @@ class _Slot:
     __slots__ = (
         "pending", "gen", "prompt_len", "length", "max_new", "eos_id",
         "temperature", "seed", "tokens", "n_dispatched", "t_first",
-        "t_last_tok",
+        "t_last_tok", "prefilling", "chunk_pos", "cached_len", "chain",
     )
 
     def __init__(self, pending: _Pending, gen: int, payload: dict,
@@ -506,6 +506,14 @@ class _Slot:
         self.tokens: list[int] = []
         self.t_first = 0.0
         self.t_last_tok = 0.0
+        # Chunked-prefill bookkeeping (chunked engines only): prompt
+        # tokens already in cache pages (cached prefix + dispatched
+        # chunks), the pinned prefix-cache match, and whether chunk
+        # dispatches remain before the slot may join decode steps.
+        self.prefilling = False
+        self.chunk_pos = 0
+        self.cached_len = 0
+        self.chain = None
 
 
 class ContinuousBatcher:
@@ -540,6 +548,18 @@ class ContinuousBatcher:
     construction; per-token observability rides the ``decode_step`` phase
     family (inter-token latencies), the ``ttft`` histogram, and the
     ``tokens`` / ``tokens_w`` counters.
+
+    On a CHUNKED engine (``prefill_chunks`` + ``prefill_chunk_size``)
+    admission consults the engine's prefix-cache trie — a hit pins the
+    matched page chain and shortens the prompt to its un-cached suffix —
+    and prefill becomes a sequence of bounded chunk dispatches, at most
+    one chunk batch per loop iteration interleaved with the decode step,
+    so in-flight slots' ITL stays bounded by one chunk's compute during
+    long-prompt admission. The final chunk samples the first token
+    (``t_first``/``ttft`` semantics unchanged) and publishes the finished
+    prefix pages back to the pool; chunk dispatches ride a batch-level
+    ``prefill_chunk`` phase/span while per-request phases keep the same
+    contiguous taxonomy (the ``prefill`` phase simply covers every chunk).
     """
 
     # Watched by obs.sanitizer.sanitize_races in tests/test_serve_decode.py;
@@ -570,6 +590,18 @@ class ContinuousBatcher:
         self._admission = admission
         self._admit_cap = min(self.config.max_batch, engine.max_batch)
         self._default_max_new = getattr(engine, "max_new_tokens", 32)
+        # Chunked-prefill engines expose prefill_chunks + a chunk size;
+        # admission then consults the prefix trie and dispatches bounded
+        # chunks interleaved with decode steps instead of one monolithic
+        # prefill. Legacy engines (and stubs) keep the original path.
+        self._chunked = (
+            callable(getattr(engine, "prefill_chunks", None))
+            and getattr(engine, "prefill_chunk_size", 0) > 0
+        )
+        self._chunk_size = getattr(engine, "prefill_chunk_size", 0)
+        self._pool = (
+            getattr(engine, "prefix_cache", None) if self._chunked else None
+        )
         self._req_ids = itertools.count()
         self._gens = itertools.count(1)
         self._cv = threading.Condition()
@@ -631,8 +663,9 @@ class ContinuousBatcher:
         return pending.future
 
     def status(self) -> dict:
+        metrics = self.metrics
         with self._cv:
-            return {
+            out = {
                 "closed": self._closed,
                 "mode": self._admission,
                 "queue_depth": self._count,
@@ -642,20 +675,56 @@ class ContinuousBatcher:
                 "slots": len(self._slots),
                 "slots_active": self._n_active,
             }
+            if self._pool is not None:
+                # KV-pressure digest for /statusz + the fleet view: pool
+                # occupancy and lifetime hit rate (lock order _cv -> pool,
+                # same as admission's trie match).
+                st = self._pool.stats()
+                lookups = metrics.prefix_lookups.value
+                out["prefix_cache"] = {
+                    "blocks": st["blocks"],
+                    "blocks_used": st["blocks_used"],
+                    "bytes_used": st["bytes_used"],
+                    "capacity_bytes": st["capacity_bytes"],
+                    "evictions": st["evictions"],
+                    "lookups": lookups,
+                    "hits": metrics.prefix_hits.value,
+                    "hit_rate": (
+                        metrics.prefix_hits.value / lookups
+                        if lookups else 0.0
+                    ),
+                    "tokens_saved": metrics.prefix_tokens_saved.value,
+                }
+            return out
 
     # --------------------------------------------------------- decode loop
 
     def _steppable(self, s: _Slot | None) -> bool:
-        """Include the slot in the next decode step? Occupied, and not
-        every requested token already dispatched (a slot whose last tokens
-        are still in flight rides along inactive until they fetch)."""
-        return s is not None and s.n_dispatched < s.max_new
+        """Include the slot in the next decode step? Occupied, fully
+        prefilled, and not every requested token already dispatched (a
+        slot whose last tokens are still in flight rides along inactive
+        until they fetch)."""
+        return (
+            s is not None
+            and not s.prefilling
+            and s.n_dispatched < s.max_new
+        )
 
     def _take_work(self):
         """Block until there is something to dispatch; returns
-        ``(admissions, step)`` — either may be empty/None — or None when
-        closed and fully drained. All bookkeeping (slot assignment, length
-        advance) happens HERE under ``_cv``; the caller just dispatches."""
+        ``(admissions, chunk_rows, step)`` — any may be empty/None — or
+        None when closed and fully drained. All bookkeeping (slot
+        assignment, trie match, chunk/length advance) happens HERE under
+        ``_cv``; the caller just dispatches.
+
+        On a chunked engine an admission does NOT dispatch a prefill:
+        the slot enters ``prefilling`` (its prompt possibly shortened by a
+        pinned prefix-cache match) and each loop iteration plans at most
+        ONE chunk batch — up to ``admit_cap`` rows, one ``chunk_size``
+        slice each — followed by a decode step over the fully-prefilled
+        slots. That interleaving is what bounds decode ITL during
+        long-prompt admission to one chunk's compute."""
+        metrics = self.metrics
         with self._cv:
             while True:
                 if (
@@ -682,12 +751,52 @@ class ContinuousBatcher:
                             p, next(self._gens), p.payload,
                             self._default_max_new,
                         )
-                        slot.n_dispatched = 1  # the prefill's first token
+                        if self._chunked:
+                            slot.prefilling = True
+                            if self._pool is not None:
+                                # Lock order _cv -> pool (never reversed);
+                                # the match pins its chain until the
+                                # gather chunk dispatches.
+                                m = self._pool.match(
+                                    p.payload["input_ids"]
+                                )
+                                slot.chain = m
+                                slot.cached_len = m.cached_len
+                                metrics.prefix_lookups.inc()
+                                if m.cached_len:
+                                    metrics.prefix_hits.inc()
+                                    metrics.prefix_tokens_saved.inc(
+                                        m.cached_len
+                                    )
+                            slot.chunk_pos = slot.cached_len
+                        else:
+                            slot.n_dispatched = 1  # prefill's first token
                         self._slots[slot_id] = slot
                         self._n_active += 1
                         admissions.append((slot_id, slot))
-                    self.metrics.queue_depth.set(self._count)
-                    self.metrics.slots_active.set(self._n_active)
+                    metrics.queue_depth.set(self._count)
+                    metrics.slots_active.set(self._n_active)
+                chunk_rows = None
+                if self._chunked:
+                    planned = []
+                    for i, s in enumerate(self._slots):
+                        if s is None or not s.prefilling:
+                            continue
+                        if len(planned) >= self._admit_cap:
+                            break
+                        start = s.chunk_pos
+                        n = min(self._chunk_size, s.prompt_len - start)
+                        s.chunk_pos = start + n
+                        final = s.chunk_pos >= s.prompt_len
+                        first = start == s.cached_len
+                        if final:
+                            s.prefilling = False
+                            s.n_dispatched = 1  # first token rides the
+                        planned.append(        # final chunk
+                            (i, s, start, n, first, final)
+                        )
+                    if planned:
+                        chunk_rows = planned
                 step = None
                 rows = [
                     (i, s) for i, s in enumerate(self._slots)
@@ -709,8 +818,8 @@ class ContinuousBatcher:
                         s.n_dispatched += 1   # pipeline without the fetch
                         tags.append((i, s.gen))
                     step = (lengths, active, temps, seeds, tags)
-                if admissions or step:
-                    return admissions, step
+                if admissions or chunk_rows or step:
+                    return admissions, chunk_rows, step
                 self._cv.wait()
 
     def _fail_slots(self, tagged: list[tuple[int, int]],
@@ -726,6 +835,8 @@ class ContinuousBatcher:
                     continue
                 self._slots[slot_id] = None
                 self._n_active -= 1
+                if self._pool is not None and s.chain is not None:
+                    self._pool.release(s.chain)  # idempotent unpin
                 victims.append(s.pending)
             metrics.slots_active.set(self._n_active)
             self._cv.notify_all()
@@ -754,10 +865,11 @@ class ContinuousBatcher:
             if work is None:
                 self._completion.put(None)  # unblock the fetch thread
                 return
-            admissions, step = work
+            admissions, chunk_rows, step = work
             if admissions:
                 self.metrics.batches.inc()
                 self.metrics.batch_occupancy.observe(len(admissions))
+            if admissions and not self._chunked:
                 self._inflight_sem.acquire()
                 tags = [(i, s.gen) for i, s in admissions]
                 try:
@@ -783,6 +895,65 @@ class ContinuousBatcher:
                     self._completion.put(
                         ("prefill", tags, handle, time.monotonic())
                     )
+            if chunk_rows:
+                self._inflight_sem.acquire()
+                tags = [(i, s.gen) for i, s, *_ in chunk_rows]
+                try:
+                    handle = engine.prefill_chunks([
+                        {
+                            "slot": i,
+                            "input_ids": s.pending.payload["input_ids"],
+                            "start": start,
+                            "n_tokens": n,
+                            "length": s.prompt_len,
+                            "chain": (
+                                s.chain.blocks
+                                if first and s.chain is not None else ()
+                            ),
+                            "temperature": s.temperature,
+                            "seed": s.seed,
+                        }
+                        for i, s, start, n, first, final in chunk_rows
+                    ])
+                except Exception as e:  # noqa: BLE001
+                    self._inflight_sem.release()
+                    self._fail_slots(tags, e)
+                else:
+                    with self._cv:
+                        self._n_inflight += 1
+                        self.metrics.in_flight.set(self._n_inflight)
+                    self._completion.put(
+                        (
+                            "chunk",
+                            [
+                                (i, s.gen, final)
+                                for i, s, _, _, _, final in chunk_rows
+                            ],
+                            handle,
+                            time.monotonic(),
+                        )
+                    )
+                    # Prefix bookkeeping AFTER the dispatch is enqueued:
+                    # the gather is in the stream, so pins drop (a later
+                    # insert may evict + rewrite those pages — stream
+                    # order keeps the gather reading the old bytes), and
+                    # a final chunk's completed pages publish to the pool.
+                    if self._pool is not None:
+                        touched = False
+                        for i, s, start, n, first, final in chunk_rows:
+                            if first and s.chain is not None:
+                                self._pool.release(s.chain)
+                            if final:
+                                new = self._pool.insert(
+                                    s.pending.payload["input_ids"]
+                                )
+                                if new:
+                                    engine.insert_prefix(i, new)
+                                touched = True
+                        if touched:
+                            self.metrics.kv_pool_bytes.set(
+                                self._pool.stats()["bytes_used"]
+                            )
             if step:
                 lengths, active, temps, seeds, tags = step
                 self._inflight_sem.acquire()
@@ -815,7 +986,9 @@ class ContinuousBatcher:
         if done:
             self._slots[slot_id] = None
             self._n_active -= 1
-            finished.append(s)
+            if self._pool is not None and s.chain is not None:
+                self._pool.release(s.chain)  # idempotent: normally
+            finished.append(s)               # already unpinned at dispatch
 
     def _resolve(self, finished: list[_Slot], now: float) -> None:
         """Resolve finished occupants' futures outside ``_cv`` with the
@@ -871,7 +1044,10 @@ class ContinuousBatcher:
             try:
                 tok = engine.fetch_step(handle)
             except Exception as e:  # noqa: BLE001
-                self._fail_slots(tags, e)
+                self._fail_slots(
+                    [(t[0], t[1]) for t in tags] if kind == "chunk"
+                    else tags, e,
+                )
                 with self._cv:
                     self._n_inflight -= 1
                     metrics.in_flight.set(self._n_inflight)
@@ -885,6 +1061,22 @@ class ContinuousBatcher:
             with self._cv:
                 if kind == "prefill":
                     for r, (slot_id, gen) in enumerate(tags):
+                        s = self._slots[slot_id]
+                        if s is None or s.gen != gen:
+                            continue
+                        s.t_first = t_got
+                        ttfts.append(t_got - s.pending.t_enqueue)
+                        n_tokens += 1
+                        self._append_token(
+                            slot_id, s, int(tok[r]), t_got, finished
+                        )
+                elif kind == "chunk":
+                    # Only rows whose chunk completed the prompt carry a
+                    # sampled first token; mid-prompt rows' lanes are
+                    # garbage by design and nothing reads them.
+                    for r, (slot_id, gen, final) in enumerate(tags):
+                        if not final:
+                            continue
                         s = self._slots[slot_id]
                         if s is None or s.gen != gen:
                             continue
@@ -924,6 +1116,20 @@ class ContinuousBatcher:
                     )
                     for dt in itls:
                         metrics.itl.observe(dt)
+            elif kind == "chunk":
+                # Batch-level span/phase twin of decode_step: one sample
+                # per chunk dispatch. Per-request phases stay the
+                # contiguous queue_wait -> prefill -> decode (a request's
+                # prefill span covers all its chunks), so phase-sum ==
+                # wall latency still holds by construction.
+                metrics.observe_phase_batch(
+                    "prefill_chunk", [t_got - t_disp], self._layout, t_got
+                )
+                if self.tracer.enabled:
+                    self.tracer.record(
+                        "prefill_chunk", t_disp, t_got, cat="serve",
+                        args={"rows": len(tags)},
+                    )
             for dt in ttfts:
                 metrics.ttft.observe(dt)
             if n_tokens:

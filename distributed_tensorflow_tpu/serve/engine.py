@@ -606,13 +606,16 @@ def _make_causal_prefill(model):
 def _make_causal_decode(model, cache_len: int):
     """Decode-step executable body (ONE shape: the full slot table): write
     each slot's pending token at its position, attend the cache prefix,
-    sample the next token. ``last`` only advances where ``active`` — an
-    idle slot's garbage lanes never reach its state (and its cache writes
-    are dead by construction: every page is re-written by a later prefill
-    or decode before anything reads it)."""
+    sample the next token. ``last`` only advances where ``active``, and
+    idle lanes carry the out-of-bounds position ``cache_len`` so their
+    garbage K/V scatters DROP — a mid-chunk-prefill slot rides decode
+    steps inactive, and a stray write would corrupt pages its earlier
+    chunks already filled (chunked prefill never re-writes them)."""
 
     def decode_fn(params, ck, cv, last, lengths, active, temps, seeds):
-        pos = jnp.minimum(lengths, cache_len - 1)
+        pos = jnp.where(
+            active, jnp.minimum(lengths, cache_len - 1), cache_len
+        )
         logits, ck, cv = model.apply(
             {"params": params}, last, pos, ck, cv, method="decode_step"
         )
@@ -621,6 +624,96 @@ def _make_causal_decode(model, cache_len: int):
         return ck, cv, last, tok
 
     return decode_fn
+
+
+def _make_causal_chunk_prefill(model, cache_len: int):
+    """Chunk-prefill executable body for one (tier, chunk bucket): a fused
+    page-gather prologue + one absolute-position prompt chunk + on-device
+    first-token sampling where the chunk completes its row's prompt.
+
+    The prologue materializes each row's matched prefix chain (pool block
+    ids in ``chain``, first ``n_gather`` entries real) into the row's slot
+    pages by gather-and-blend — fusing it here instead of a separate
+    executable saves a dispatch/completion round per admission. Rows past
+    their first chunk (and cache-miss rows) pass ``n_gather == 0`` and
+    blend back their own pages unchanged. Pool pages are READ-ONLY in this
+    executable: requests diverging after a shared head extend private
+    copies, which is the pool's copy-on-read isolation contract.
+
+    Per-lane validity comes from ``starts``/``lengths``: lane ``c`` of row
+    ``t`` holds absolute position ``starts[t] + c`` when in range and the
+    out-of-range sentinel ``cache_len`` otherwise, so padding lanes (and
+    whole padding rows, which also carry slot index == S) write nowhere.
+    ``is_last`` rows sample their first token at the prompt's final lane,
+    keyed on absolute position exactly like the monolithic prefill — bit
+    parity with the cold path follows."""
+
+    def chunk_fn(params, ck, cv, last, pool_k, pool_v, ids, starts,
+                 lengths, chain, n_gather, slots, temps, seeds):
+        nl = ck.shape[0]
+        T, C = ids.shape
+        rows_k = ck[:, slots]  # [nl, T, Lc, h, d]; padding slot ix clamps
+        rows_v = cv[:, slots]
+        bt = pool_k.shape[2]
+        M = chain.shape[1]
+        span = M * bt
+        gk = pool_k[:, chain].reshape(nl, T, span, *pool_k.shape[3:])
+        gv = pool_v[:, chain].reshape(nl, T, span, *pool_v.shape[3:])
+        sel = (
+            jnp.arange(span)[None, :] < (n_gather * bt)[:, None]
+        )[None, :, :, None, None]
+        rows_k = rows_k.at[:, :, :span].set(
+            jnp.where(sel, gk, rows_k[:, :, :span])
+        )
+        rows_v = rows_v.at[:, :, :span].set(
+            jnp.where(sel, gv, rows_v[:, :, :span])
+        )
+        pos = starts[:, None] + jnp.arange(C)[None, :]
+        wpos = jnp.where(pos < lengths[:, None], pos, cache_len)
+        logits, nk, nv = model.apply(
+            {"params": params}, ids, wpos, rows_k, rows_v,
+            method="prefill_chunk",
+        )
+        ck = ck.at[:, slots].set(nk, mode="drop")
+        cv = cv.at[:, slots].set(nv, mode="drop")
+        is_last = starts + C >= lengths
+        li = jnp.clip(lengths - 1 - starts, 0, C - 1)
+        tok = sample_tokens(
+            logits[jnp.arange(T), li], temps, seeds, lengths
+        )
+        upd = jnp.where(is_last, tok, jnp.take(last, slots, mode="clip"))
+        last = last.at[slots].set(upd, mode="drop")
+        return ck, cv, last, tok
+
+    return chunk_fn
+
+
+def _make_prefix_insert(block_tokens: int):
+    """Publish-to-pool executable body: copy a finished slot's prefix
+    pages into newly allocated pool blocks (``block_ids``/``block_pos``
+    padded with the out-of-pool sentinel, whose scatters drop).
+
+    The slot caches are DONATED and returned untouched so the donation
+    chain through the engine's device state stays linear — every
+    executable (chunk -> insert -> decode) consumes the previous one's
+    outputs, and XLA aliases buffers instead of copying to protect a
+    still-referenced operand."""
+
+    def insert_fn(pool_k, pool_v, ck, cv, slot, block_ids, block_pos):
+        nl, _, lc = ck.shape[:3]
+        nb = lc // block_tokens
+        src_k = ck[:, slot, : nb * block_tokens].reshape(
+            nl, nb, block_tokens, *ck.shape[3:]
+        )
+        src_v = cv[:, slot, : nb * block_tokens].reshape(
+            nl, nb, block_tokens, *cv.shape[3:]
+        )
+        bp = jnp.minimum(block_pos, nb - 1)
+        pool_k = pool_k.at[:, block_ids].set(src_k[:, bp], mode="drop")
+        pool_v = pool_v.at[:, block_ids].set(src_v[:, bp], mode="drop")
+        return pool_k, pool_v, ck, cv
+
+    return insert_fn
 
 
 class CausalLMEngine(_AotEngine):
@@ -660,6 +753,21 @@ class CausalLMEngine(_AotEngine):
     stay coherent, and decode batches are tiny). Expert/pipeline axes are
     rejected at startup. DP axes likewise replicate: a decode engine is
     one replica; fleet scale-out is N engines behind the router contract.
+
+    **Chunked mode** (``prefix_cache_mb > 0`` or ``prefill_chunk > 0``)
+    swaps the monolithic prefill grid for a CHUNK grid — one executable
+    per (tier x chunk bucket), each a fused page-gather prologue + one
+    absolute-position prompt chunk (see :func:`_make_causal_chunk_prefill`)
+    — so prompt admission becomes a sequence of bounded chunk dispatches
+    the batcher interleaves with decode steps. With a prefix-cache budget
+    the engine also owns a device-resident pool of KV pages ``[nl,
+    n_blocks, block_tokens, heads, head_dim]`` (sharded like the slot
+    cache, so TP gathers pages with per-shard head dims) indexed by a host
+    :class:`~..serve.kvpool.KVBlockPool` trie, plus one ``insert``
+    executable that publishes a finished slot's prefix pages back to the
+    pool. A chunk at ``start == 0`` with nothing to gather is exactly the
+    monolithic prefill, so legacy mode (both knobs 0) keeps the original
+    grid and byte-identical behavior.
     """
 
     def __init__(
@@ -673,6 +781,9 @@ class CausalLMEngine(_AotEngine):
         max_batch: int = 4,
         batch_tiers: tuple[int, ...] | None = None,
         max_new_tokens: int = 32,
+        prefix_cache_mb: float = 0.0,
+        block_tokens: int = 16,
+        prefill_chunk: int = 0,
     ):
         if slots < 1:
             raise ValueError(f"need at least one cache slot, got {slots}")
@@ -730,26 +841,116 @@ class CausalLMEngine(_AotEngine):
             jnp.zeros((slots,), jnp.int32), self._rep
         )
 
-        # The grid: prefill per (tier x bucket) + ONE decode step. Cache /
-        # last_token operands are donated — XLA updates the pool in place,
-        # and the engine swaps its refs for the returned ones at dispatch.
+        # Prefix-cache / chunked-prefill plumbing. Legacy mode (both knobs
+        # 0) compiles the original monolithic prefill grid; chunked mode
+        # compiles the chunk grid INSTEAD (a start-0 chunk subsumes it),
+        # so startup never pays both.
+        from distributed_tensorflow_tpu.serve.kvpool import KVBlockPool
+
+        self.block_tokens = int(block_tokens)
+        self._chunked_mode = prefix_cache_mb > 0 or prefill_chunk > 0
+        self.prefix_cache = None
+        if self._chunked_mode:
+            chunk = int(prefill_chunk) if prefill_chunk > 0 \
+                else self.buckets[-1]
+            self.prefill_chunk_size = min(chunk, self.buckets[-1])
+            self._chunk_buckets = tuple(sorted(
+                {b for b in self.buckets if b <= self.prefill_chunk_size}
+                | {self.prefill_chunk_size}
+            ))
+            self._max_chain = max(1, self.buckets[-1] // self.block_tokens)
+            n_blocks, self._bytes_per_block = self._plan_prefix_cache(
+                cfg, tp=tp, prefix_cache_mb=prefix_cache_mb,
+                block_tokens=self.block_tokens,
+            )
+            if prefix_cache_mb > 0:
+                self.prefix_cache = KVBlockPool(
+                    n_blocks, self.block_tokens, self._bytes_per_block
+                )
+            else:
+                n_blocks = 1  # dummy pool keeps one chunk operand layout
+            pool_shape = (
+                cfg.num_layers, n_blocks, self.block_tokens,
+                cfg.num_heads, cfg.hidden_size // cfg.num_heads,
+            )
+            self._pool_blocks = n_blocks
+            self._pool_k = jax.device_put(
+                jnp.zeros(pool_shape, cfg.dtype), self._cache_sharding
+            )
+            self._pool_v = jax.device_put(
+                jnp.zeros(pool_shape, cfg.dtype), self._cache_sharding
+            )
+        else:
+            self.prefill_chunk_size = 0
+
+        # The grid: prefill per (tier x bucket) — or chunk-prefill per
+        # (tier x chunk bucket) — + ONE decode step. Cache / last_token
+        # operands are donated — XLA updates the pool in place, and the
+        # engine swaps its refs for the returned ones at dispatch.
         self._prefill_compiled = {}
-        for T in self.batch_tiers:
-            fn = self._wrap(_make_causal_prefill(self.model), n_batch=6)
-            for L in self.buckets:
-                self._prefill_compiled[T, L] = (
-                    jax.jit(fn, donate_argnums=(1, 2, 3))
+        self._chunk_compiled = {}
+        if not self._chunked_mode:
+            for T in self.batch_tiers:
+                fn = self._wrap(_make_causal_prefill(self.model), n_batch=6)
+                for L in self.buckets:
+                    self._prefill_compiled[T, L] = (
+                        jax.jit(fn, donate_argnums=(1, 2, 3))
+                        .lower(
+                            self.params,
+                            self._cache_struct(cache_shape, cfg.dtype),
+                            self._cache_struct(cache_shape, cfg.dtype),
+                            self._rep_struct((slots,), jnp.int32),
+                            self._rep_struct((T, L), jnp.int32),
+                            self._rep_struct((T, L), jnp.bool_),
+                            self._rep_struct((T,), jnp.int32),
+                            self._rep_struct((T,), jnp.int32),
+                            self._rep_struct((T,), jnp.float32),
+                            self._rep_struct((T,), jnp.int32),
+                        )
+                        .compile()
+                    )
+        else:
+            chunk_fn = self._wrap_chunk(
+                _make_causal_chunk_prefill(self.model, self.cache_len)
+            )
+            pool_struct = self._cache_struct(pool_shape, cfg.dtype)
+            for T in self.batch_tiers:
+                for C in self._chunk_buckets:
+                    self._chunk_compiled[T, C] = (
+                        jax.jit(chunk_fn, donate_argnums=(1, 2, 3))
+                        .lower(
+                            self.params,
+                            self._cache_struct(cache_shape, cfg.dtype),
+                            self._cache_struct(cache_shape, cfg.dtype),
+                            self._rep_struct((slots,), jnp.int32),
+                            pool_struct,
+                            pool_struct,
+                            self._rep_struct((T, C), jnp.int32),
+                            self._rep_struct((T,), jnp.int32),
+                            self._rep_struct((T,), jnp.int32),
+                            self._rep_struct((T, self._max_chain),
+                                             jnp.int32),
+                            self._rep_struct((T,), jnp.int32),
+                            self._rep_struct((T,), jnp.int32),
+                            self._rep_struct((T,), jnp.float32),
+                            self._rep_struct((T,), jnp.int32),
+                        )
+                        .compile()
+                    )
+            if self.prefix_cache is not None:
+                insert_fn = self._wrap_insert(
+                    _make_prefix_insert(self.block_tokens)
+                )
+                self._insert_compiled = (
+                    jax.jit(insert_fn, donate_argnums=(0, 1, 2, 3))
                     .lower(
-                        self.params,
+                        pool_struct,
+                        pool_struct,
                         self._cache_struct(cache_shape, cfg.dtype),
                         self._cache_struct(cache_shape, cfg.dtype),
-                        self._rep_struct((slots,), jnp.int32),
-                        self._rep_struct((T, L), jnp.int32),
-                        self._rep_struct((T, L), jnp.bool_),
-                        self._rep_struct((T,), jnp.int32),
-                        self._rep_struct((T,), jnp.int32),
-                        self._rep_struct((T,), jnp.float32),
-                        self._rep_struct((T,), jnp.int32),
+                        self._rep_struct((), jnp.int32),
+                        self._rep_struct((self._max_chain,), jnp.int32),
+                        self._rep_struct((self._max_chain,), jnp.int32),
                     )
                     .compile()
                 )
@@ -772,9 +973,12 @@ class CausalLMEngine(_AotEngine):
         )
         logger.info(
             "causal-LM engine ready: layout=%s slots=%d cache_len=%d "
-            "buckets=%s tiers=%s (%d executables)",
+            "buckets=%s tiers=%s chunk=%s pool_blocks=%s (%d executables)",
             self.layout, slots, self.cache_len, self.buckets,
-            self.batch_tiers, len(self._prefill_compiled) + 1,
+            self.batch_tiers, self.prefill_chunk_size or None,
+            self.prefix_cache.n_blocks if self.prefix_cache else None,
+            len(self._prefill_compiled) + len(self._chunk_compiled) + 1
+            + (1 if self.prefix_cache is not None else 0),
         )
 
     @staticmethod
@@ -806,6 +1010,38 @@ class CausalLMEngine(_AotEngine):
             )
         return cfg
 
+    @staticmethod
+    def _plan_prefix_cache(cfg, *, tp: int = 1, prefix_cache_mb: float = 0.0,
+                           block_tokens: int = 16) -> tuple[int, int]:
+        """Size + validate the prefix-page pool for this config/layout:
+        ``(n_blocks, bytes_per_block)``. Raises ``ValueError`` loudly at
+        startup (shardcheck's SC002 sweep crosses layouts with these
+        configs) — a budget smaller than one block or a TP degree that
+        cannot split the pages' head axis must never become a shape error
+        mid-request."""
+        if block_tokens < 1:
+            raise ValueError(
+                f"block_tokens must be >= 1, got {block_tokens}"
+            )
+        if tp > 1 and cfg.num_heads % tp:
+            raise ValueError(
+                f"model axis of {tp} must divide num_heads "
+                f"({cfg.num_heads}) to shard prefix-cache pages"
+            )
+        bytes_per_block = (
+            2 * cfg.num_layers * block_tokens * cfg.hidden_size
+            * jnp.dtype(cfg.dtype).itemsize
+        )
+        n_blocks = int(prefix_cache_mb * 2**20 // bytes_per_block)
+        if prefix_cache_mb > 0 and n_blocks < 1:
+            raise ValueError(
+                f"--prefix-cache-mb {prefix_cache_mb:g} holds no "
+                f"{bytes_per_block}-byte block (num_layers="
+                f"{cfg.num_layers}, block_tokens={block_tokens}, "
+                f"hidden={cfg.hidden_size})"
+            )
+        return n_blocks, bytes_per_block
+
     def _cache_struct(self, shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype,
                                     sharding=self._cache_sharding)
@@ -830,6 +1066,36 @@ class CausalLMEngine(_AotEngine):
             check_vma=False,
         )
 
+    def _wrap_chunk(self, fn):
+        """Chunk-prefill twin of ``_wrap``: the pool pages shard their
+        head axis exactly like the slot cache (per-shard gathers stay
+        local — no cross-shard page traffic), everything else replicates."""
+        if not self._model_sharded:
+            return fn
+        cache, rep = self._cache_spec, P()
+        in_specs = (
+            self._param_specs, cache, cache, rep, cache, cache,
+        ) + (rep,) * 8
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=in_specs,
+            out_specs=(cache, cache, rep, rep),
+            check_vma=False,
+        )
+
+    def _wrap_insert(self, fn):
+        if not self._model_sharded:
+            return fn
+        cache, rep = self._cache_spec, P()
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(cache, cache, cache, cache, rep, rep, rep),
+            out_specs=(cache, cache, cache, cache),
+            check_vma=False,
+        )
+
     # -- request surface ------------------------------------------------
 
     def bucket_for(self, length: int) -> int:
@@ -839,6 +1105,15 @@ class CausalLMEngine(_AotEngine):
         raise RequestError(
             f"prompt length {length} exceeds the largest bucket "
             f"{self.buckets[-1]}"
+        )
+
+    def _chunk_bucket_for(self, n: int) -> int:
+        for c in self._chunk_buckets:
+            if n <= c:
+                return c
+        raise ValueError(
+            f"chunk of {n} exceeds prefill_chunk_size "
+            f"{self.prefill_chunk_size}"
         )
 
     def validate(self, payload: dict) -> None:
@@ -869,6 +1144,11 @@ class CausalLMEngine(_AotEngine):
         ``admissions`` rows: ``{"slot", "input_ids", "temperature",
         "seed"}``. Returns without blocking; ``fetch_step`` yields the
         [tier]-shaped first-token vector (real rows = admitted order)."""
+        if self._chunked_mode:
+            raise RuntimeError(
+                "engine compiled in chunked-prefill mode (prefix cache / "
+                "prefill_chunk); admissions go through prefill_chunks"
+            )
         if len(admissions) > self.max_batch:
             raise ValueError(
                 f"admitting {len(admissions)} exceeds max_batch "
@@ -922,6 +1202,119 @@ class CausalLMEngine(_AotEngine):
             meta=[int(s) for s in slot_ix[: len(admissions)]],
             buffers=buffers, layout=self.layout, t_assembled=t_assembled,
         )
+
+    def prefill_chunks(self, rows: list[dict]) -> InFlightBatch:
+        """Dispatch ONE prefill chunk for up to a tier of admitted slots.
+
+        ``rows``: ``{"slot", "input_ids" (the FULL prompt), "start",
+        "n_tokens", "length", "chain" (pool block ids — non-empty only on
+        a row's first chunk, when its matched prefix gathers),
+        "temperature", "seed"}``. The executable slices nothing: the host
+        stages ``input_ids[start : start + n_tokens]`` per row, pads to
+        the smallest (tier, chunk-bucket) cell, and rows whose chunk
+        completes the prompt sample their first token on-device (rows
+        mid-prompt return garbage lanes the batcher ignores)."""
+        if not self._chunked_mode:
+            raise RuntimeError(
+                "prefill_chunks needs chunked mode (prefix_cache_mb or "
+                "prefill_chunk at construction)"
+            )
+        if len(rows) > self.max_batch:
+            raise ValueError(
+                f"admitting {len(rows)} exceeds max_batch {self.max_batch}"
+            )
+        T = self.tier_for(len(rows))
+        C = self._chunk_bucket_for(max(int(r["n_tokens"]) for r in rows))
+        M = self._max_chain
+        key = ("chunk", T, C)
+
+        def _make():
+            return (
+                np.zeros((T, C), np.int32),
+                np.zeros((T,), np.int32),
+                np.zeros((T,), np.int32),
+                np.zeros((T, M), np.int32),
+                np.zeros((T,), np.int32),
+                np.full((T,), self.slots, np.int32),
+                np.zeros((T,), np.float32),
+                np.zeros((T,), np.int32),
+            )
+
+        ids, starts, lengths, chain, n_gather, slot_ix, temps, seeds = (
+            buffers
+        ) = self._take_buffers(key, _make)
+        ids.fill(0)
+        starts.fill(0)
+        lengths.fill(0)
+        chain.fill(0)
+        n_gather.fill(0)
+        slot_ix.fill(self.slots)  # out-of-pool: padding rows scatter-drop
+        temps.fill(0.0)
+        seeds.fill(0)
+        for r, row in enumerate(rows):
+            s0, n = int(row["start"]), int(row["n_tokens"])
+            ids[r, :n] = np.asarray(
+                row["input_ids"][s0:s0 + n], np.int32
+            )
+            starts[r] = s0
+            lengths[r] = int(row["length"])
+            blocks = row.get("chain") or ()
+            if len(blocks) > M:
+                raise ValueError(
+                    f"prefix chain of {len(blocks)} exceeds max chain {M}"
+                )
+            chain[r, :len(blocks)] = blocks
+            n_gather[r] = len(blocks)
+            slot_ix[r] = int(row["slot"])
+            temps[r] = float(row.get("temperature", 0.0))
+            seeds[r] = int(row.get("seed", 0))
+        t_assembled = time.monotonic()
+        ck, cv, last, tok = self._chunk_compiled[T, C](
+            self.params, self._cache_k, self._cache_v, self._last_token,
+            self._pool_k, self._pool_v,
+            jax.device_put(ids, self._rep),
+            jax.device_put(starts, self._rep),
+            jax.device_put(lengths, self._rep),
+            jax.device_put(chain, self._rep),
+            jax.device_put(n_gather, self._rep),
+            jax.device_put(slot_ix, self._rep),
+            jax.device_put(temps, self._rep),
+            jax.device_put(seeds, self._rep),
+        )
+        self._cache_k, self._cache_v, self._last_token = ck, cv, last
+        self._record_dispatch(T, C, len(rows))
+        return InFlightBatch(
+            out={"tok": tok}, key=key, n=len(rows),
+            meta=[int(s) for s in slot_ix[: len(rows)]],
+            buffers=buffers, layout=self.layout, t_assembled=t_assembled,
+        )
+
+    def insert_prefix(self, slot: int, blocks: list[tuple[int, int]]) -> None:
+        """Publish a fully-prefilled slot's prefix pages into the pool:
+        ``blocks`` are ``(block_id, block_index)`` pairs from
+        ``KVBlockPool.insert``. Dispatch-only (nothing to fetch — the
+        batcher never blocks on it); stream order guarantees the pages
+        hold the prompt's K/V before any later chunk can gather them."""
+        if self.prefix_cache is None:
+            raise RuntimeError("engine has no prefix cache")
+        M = self._max_chain
+        if len(blocks) > M:
+            raise ValueError(
+                f"inserting {len(blocks)} blocks exceeds max chain {M}"
+            )
+        ids = np.full((M,), self._pool_blocks, np.int32)  # sentinel: drop
+        pos = np.zeros((M,), np.int32)
+        for j, (bid, bix) in enumerate(blocks):
+            ids[j] = int(bid)
+            pos[j] = int(bix)
+        pk, pv, ck, cv = self._insert_compiled(
+            self._pool_k, self._pool_v, self._cache_k, self._cache_v,
+            jax.device_put(np.int32(slot), self._rep),
+            jax.device_put(ids, self._rep),
+            jax.device_put(pos, self._rep),
+        )
+        self._pool_k, self._pool_v = pk, pv
+        self._cache_k, self._cache_v = ck, cv
 
     def decode(self, lengths, active, temps, seeds) -> InFlightBatch:
         """Dispatch ONE decode step over the full slot table (host arrays

@@ -57,6 +57,20 @@ docs/PERF.md round-11 numbers are recorded from).
     JAX_PLATFORMS=cpu python scripts/serve_bench.py --decode
     python scripts/serve_bench.py --decode --slots 16 --sim-step-ms 5
     python scripts/serve_bench.py --decode --quick   # CI gate (~seconds)
+
+Decode mode also runs two prefix/chunk A/Bs (round 12):
+
+* **Prefix-cache A/B** — a Zipf shared-prefix workload (a few hot prompt
+  heads, random tails) runs through a REAL tiny causal-LM engine twice:
+  prefix cache + chunked prefill ON vs the legacy cold path. Streams must
+  be bit-identical; the table reports hit rate, prompt tokens saved,
+  TTFT p50/p99, and tokens/s. ``--quick`` gates parity and a nonzero hit
+  rate (the perf ratios are recorded in docs/PERF.md from full runs).
+* **Chunked-prefill ITL A/B** — a sim engine whose prefill cost is
+  proportional to tokens prefilled decodes a short-prompt backlog while
+  long prompts admit mid-flight, once with a bounded prefill chunk and
+  once monolithic. ``--quick`` gates the chunked arm's decode ITL p99
+  during admission to <= 2x its long-prompt-free steady state.
 """
 
 from __future__ import annotations
@@ -344,6 +358,63 @@ def _sim_expected(payload: dict) -> list[int]:
     ]
 
 
+class SimChunkedEngine(SimStepEngine):
+    """Chunked-prefill twin of :class:`SimStepEngine`: prefill cost is
+    PROPORTIONAL to the tokens prefilled (``token_cost_ms`` each, the
+    cost model under which monolithic long-prompt admission stalls the
+    decode loop), dispatched through the batcher's ``prefill_chunks``
+    path. ``prefill_chunk`` is the bound under test — pass the max prompt
+    length to get the monolithic baseline arm through the same code."""
+
+    def __init__(self, *, slots: int, max_batch: int, max_new_tokens: int,
+                 step_ms: float, prefill_chunk: int, token_cost_ms: float):
+        super().__init__(slots=slots, max_batch=max_batch,
+                         max_new_tokens=max_new_tokens, step_ms=step_ms)
+        self.prefill_chunk_size = prefill_chunk
+        self.prefix_cache = None
+        self.token_cost_s = token_cost_ms / 1e3
+
+    def prefill_chunks(self, rows: list[dict]):
+        with self._lock:
+            toks, worst = [], 0.0
+            for r in rows:
+                worst = max(worst, int(r["n_tokens"]) * self.token_cost_s)
+                if int(r["start"]) + int(r["n_tokens"]) >= int(r["length"]):
+                    psum = int(np.sum(r["input_ids"]))
+                    self._state[int(r["slot"])] = (psum, 1)
+                    toks.append(self.token(psum, 0))
+                else:
+                    toks.append(0)  # mid-prompt lane: nobody reads it
+        return ("chunk", worst, toks)
+
+    def fetch_step(self, handle):
+        if isinstance(handle, tuple) and handle[0] == "chunk":
+            time.sleep(handle[1])
+            return np.asarray(handle[2])
+        return super().fetch_step(handle)
+
+
+def make_prefix_payloads(n: int, *, heads: int, head_len: int,
+                         tail_lens: tuple[int, int], max_new: int,
+                         vocab: int = 64, seed: int = 0) -> list[dict]:
+    """Zipf shared-prefix workload: ``heads`` hot prompt heads of
+    ``head_len`` tokens (system prompts / few-shot preambles), each
+    request picks one Zipf(1.1)-distributed and appends a random tail of
+    ``tail_lens`` tokens — the traffic shape prefix caching pays for:
+    most requests re-prefill a head some earlier request already paid."""
+    rng = np.random.default_rng(seed)
+    pool = [rng.integers(5, vocab, size=head_len) for _ in range(heads)]
+    out = []
+    for _ in range(n):
+        h = pool[min(int(rng.zipf(1.1)) - 1, heads - 1)]
+        tail = rng.integers(5, vocab, size=int(rng.integers(*tail_lens)))
+        out.append({
+            "input_ids": np.concatenate([h, tail]),
+            "max_new_tokens": int(rng.integers(2, max_new + 1)),
+        })
+    return out
+
+
 def _decode_parity_probe(n_requests: int) -> tuple[bool, float]:
     """Numerics tripwire ahead of the sim A/B: a real (tiny) causal-LM
     engine decodes a mixed backlog through the continuous batcher — more
@@ -505,6 +576,202 @@ def _run_decode_point(args, admission: str, payloads: list[dict],
     }
 
 
+def _run_prefix_cache_ab(args) -> dict:
+    """Prefix-cache A/B on a REAL tiny engine: the same Zipf shared-prefix
+    stream runs cache-on (prefix pool + chunked prefill) and cache-off
+    (legacy monolithic prefill); streams must be bit-identical and the
+    cache arm reports hit rate / tokens saved / TTFT / tokens/s."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        CausalLMEngine,
+        Client,
+    )
+
+    if args.quick:
+        # CI shape: gate correctness (parity, nonzero hit rate), not perf
+        # — at this size dispatch overhead swamps the prefill compute the
+        # cache saves, so the ratios are meaningless.
+        geo = dict(hidden=32, layers=2, heads=2, maxpos=48,
+                   buckets=(8, 32), head_len=24, tails=(3, 8),
+                   chunk=16, bt=4, mb=0.25, n=16)
+    else:
+        # Perf shape (docs/PERF.md round 12): heads long enough that
+        # re-prefilling one costs real compute — the regime prefix
+        # caching exists for.
+        geo = dict(hidden=128, layers=4, heads=4, maxpos=384,
+                   buckets=(32, 256), head_len=192, tails=(3, 16),
+                   chunk=64, bt=16, mb=8.0, n=48)
+    cfg = CausalLMConfig(
+        vocab_size=64, hidden_size=geo["hidden"],
+        num_layers=geo["layers"], num_heads=geo["heads"],
+        intermediate_size=4 * geo["hidden"], max_position=geo["maxpos"],
+    )
+    model = CausalLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+        jnp.ones((1, 8), bool),
+    )["params"]
+    n = geo["n"]
+    payloads = make_prefix_payloads(
+        n, heads=3, head_len=geo["head_len"], tail_lens=geo["tails"],
+        max_new=6, vocab=cfg.vocab_size,
+    )
+    # One warm request per distinct head primes the trie (and both arms'
+    # dispatch machinery) outside the measured window.
+    seen, warm_idx = set(), []
+    for i, p in enumerate(payloads):
+        key = tuple(int(t) for t in p["input_ids"][:geo["head_len"]])
+        if key not in seen:
+            seen.add(key)
+            warm_idx.append(i)
+
+    arms = {}
+    for name, kw in (
+        ("cache_on", dict(prefix_cache_mb=geo["mb"],
+                          block_tokens=geo["bt"],
+                          prefill_chunk=geo["chunk"])),
+        ("cache_off", {}),
+    ):
+        engine = CausalLMEngine(
+            model, params, buckets=geo["buckets"], slots=4, max_batch=2,
+            max_new_tokens=8, **kw,
+        )
+        with Client(
+            engine,
+            BatcherConfig(max_batch=2, max_queue=4 * n, max_in_flight=2),
+        ) as client:
+            m = client.metrics
+            for i in warm_idx:
+                client.call(dict(payloads[i]), timeout=300)
+            m.ttft.reset()
+            lk0, h0, sv0 = (m.prefix_lookups.value, m.prefix_hits.value,
+                            m.prefix_tokens_saved.value)
+            t0 = time.monotonic()
+            futs = [client.submit(dict(p)) for p in payloads]
+            results = [f.result(timeout=600) for f in futs]
+            wall = time.monotonic() - t0
+            snap = m.snapshot()
+            lookups = m.prefix_lookups.value - lk0
+            hits = m.prefix_hits.value - h0
+            arms[name] = {
+                "streams": [r["tokens"] for r in results],
+                "requests": n,
+                "wall_s": wall,
+                "tokens_per_s": sum(r["n_tokens"] for r in results) / wall,
+                "ttft_p50_ms": snap["ttft_ms"]["p50"],
+                "ttft_p99_ms": snap["ttft_ms"]["p99"],
+                "hit_rate": hits / lookups if lookups else 0.0,
+                "tokens_saved": m.prefix_tokens_saved.value - sv0,
+                "kv_pool_bytes": snap["kv_pool_bytes"],
+            }
+    on, off = arms["cache_on"], arms["cache_off"]
+    mismatched = sum(
+        a != b for a, b in zip(on.pop("streams"), off.pop("streams"))
+    )
+    return {
+        "workload": {"requests": n, "heads": 3,
+                     "head_len": geo["head_len"],
+                     "hidden": geo["hidden"], "layers": geo["layers"],
+                     "prefill_chunk": geo["chunk"],
+                     "block_tokens": geo["bt"]},
+        "cache_on": on,
+        "cache_off": off,
+        "mismatched_streams": mismatched,
+        "ttft_p50_ratio": (
+            off["ttft_p50_ms"] / on["ttft_p50_ms"]
+            if on["ttft_p50_ms"] else 1.0
+        ),
+        "tokens_per_s_ratio": (
+            on["tokens_per_s"] / off["tokens_per_s"]
+            if off["tokens_per_s"] else 1.0
+        ),
+    }
+
+
+def _run_chunked_itl_ab(args) -> dict:
+    """Chunked-prefill ITL A/B (sim): a short-prompt decode backlog keeps
+    the slot table busy; long prompts then admit mid-flight. The chunked
+    arm prefills them ``chunk`` tokens per loop iteration interleaved
+    with decode steps; the monolithic arm stalls every in-flight slot for
+    the whole prompt. Reported per arm: steady-state decode ITL p99 (no
+    long prompts) vs ITL p99 during long-prompt admission."""
+    from distributed_tensorflow_tpu.serve import BatcherConfig, Client
+
+    rng = np.random.default_rng(3)
+    n_short = 16 if args.quick else 48
+    shorts = [
+        {
+            "input_ids": rng.integers(5, 512, size=int(rng.integers(4, 17))),
+            "max_new_tokens": int(rng.integers(6, 13)),
+        }
+        for _ in range(n_short)
+    ]
+    longs = [
+        {
+            "input_ids": rng.integers(5, 512, size=224),
+            "max_new_tokens": 4,
+        }
+        for _ in range(3)
+    ]
+    chunk_bound = 16
+    token_cost_ms = args.sim_step_ms / 32.0
+    arms = {}
+    mismatched = 0
+    for name, chunk in (("chunked", chunk_bound), ("monolithic", 256)):
+        eng = SimChunkedEngine(
+            slots=8, max_batch=4, max_new_tokens=16,
+            step_ms=args.sim_step_ms, prefill_chunk=chunk,
+            token_cost_ms=token_cost_ms,
+        )
+        client = Client(
+            eng,
+            BatcherConfig(max_batch=4, max_queue=1024, max_in_flight=2),
+        )
+        m = client.metrics
+        try:
+            client.call(dict(shorts[0]), timeout=120)
+            m.itl.reset()
+            futs = [client.submit(dict(p)) for p in shorts]
+            res = [f.result(timeout=600) for f in futs]
+            mismatched += sum(
+                r["tokens"] != _sim_expected(p)
+                for p, r in zip(shorts, res)
+            )
+            steady = m.snapshot()["itl_ms"]["p99"]
+            m.itl.reset()
+            futs = [client.submit(dict(p)) for p in shorts + longs]
+            res = [f.result(timeout=600) for f in futs]
+            mismatched += sum(
+                r["tokens"] != _sim_expected(p)
+                for p, r in zip(shorts + longs, res)
+            )
+            admit = m.snapshot()["itl_ms"]["p99"]
+        finally:
+            client.close()
+        arms[name] = {
+            "prefill_chunk": chunk,
+            "steady_itl_p99_ms": steady,
+            "admission_itl_p99_ms": admit,
+            "itl_p99_ratio": admit / steady if steady else float("inf"),
+        }
+    return {
+        "config": {
+            "short_requests": n_short,
+            "long_prompt_tokens": 224,
+            "token_cost_ms": token_cost_ms,
+        },
+        "arms": arms,
+        "mismatched_streams": mismatched,
+    }
+
+
 def run_decode(args) -> int:
     """The continuous-batching decode A/B (--decode)."""
     payloads = make_decode_payloads(
@@ -580,6 +847,48 @@ def run_decode(args) -> int:
         f"{100 * max_div:.1f}%"
     )
 
+    print("\n# prefix-cache A/B: real tiny engine, Zipf shared-prefix "
+          "workload, cache-on (KV pool + chunked prefill) vs cache-off")
+    prefix = _run_prefix_cache_ab(args)
+    hdr = (
+        f"{'arm':>10} {'tok/s':>8} {'ttft p50':>9} {'ttft p99':>9} "
+        f"{'hit rate':>9} {'tok saved':>10} {'pool KiB':>9}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for name in ("cache_on", "cache_off"):
+        a = prefix[name]
+        print(
+            f"{name:>10} {a['tokens_per_s']:>8.1f} "
+            f"{a['ttft_p50_ms']:>9.1f} {a['ttft_p99_ms']:>9.1f} "
+            f"{a['hit_rate']:>9.2f} {a['tokens_saved']:>10d} "
+            f"{a['kv_pool_bytes'] / 1024:>9.1f}"
+        )
+    print(
+        f"prefix cache vs cold: ttft p50 "
+        f"{prefix['ttft_p50_ratio']:.2f}x better, tokens/s "
+        f"{prefix['tokens_per_s_ratio']:.2f}x, "
+        f"{prefix['mismatched_streams']} mismatched streams"
+    )
+
+    print("\n# chunked-prefill ITL A/B: sim engine, long-prompt admission "
+          "against a short-prompt decode backlog")
+    itl = _run_chunked_itl_ab(args)
+    hdr = (
+        f"{'arm':>11} {'chunk':>6} {'steady itl p99':>15} "
+        f"{'admission itl p99':>18} {'ratio':>6}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for name in ("chunked", "monolithic"):
+        a = itl["arms"][name]
+        print(
+            f"{name:>11} {a['prefill_chunk']:>6d} "
+            f"{a['steady_itl_p99_ms']:>15.2f} "
+            f"{a['admission_itl_p99_ms']:>18.2f} "
+            f"{a['itl_p99_ratio']:>6.2f}"
+        )
+
     if args.json:
         report = {
             "mode": "decode",
@@ -597,6 +906,8 @@ def run_decode(args) -> int:
             "speedup_tokens_per_s": speedup,
             "ttft_p50_ratio": ttft_ratio,
             "max_phase_divergence": max_div,
+            "prefix_cache_ab": prefix,
+            "chunked_itl_ab": itl,
         }
         with open(args.json, "w") as fh:
             json.dump(report, fh, indent=2)
@@ -612,7 +923,27 @@ def run_decode(args) -> int:
         print(f"FAIL: {mismatched} sim token streams misrouted by the "
               "slot-table scheduler", file=sys.stderr)
         return 1
+    if prefix["mismatched_streams"]:
+        print(f"FAIL: {prefix['mismatched_streams']} cached streams "
+              "diverge from the cold-prefill reference — prefix-cache "
+              "reuse must be bit-exact", file=sys.stderr)
+        return 1
+    if itl["mismatched_streams"]:
+        print(f"FAIL: {itl['mismatched_streams']} sim token streams "
+              "corrupted by chunked-prefill interleaving", file=sys.stderr)
+        return 1
     if args.quick:
+        if prefix["cache_on"]["hit_rate"] <= 0.0:
+            print("FAIL: prefix-cache hit rate is 0 on a shared-prefix "
+                  "workload — the trie never matched", file=sys.stderr)
+            return 1
+        chunk_ratio = itl["arms"]["chunked"]["itl_p99_ratio"]
+        if chunk_ratio > 2.0:
+            print(f"FAIL: chunked-prefill decode ITL p99 during "
+                  f"long-prompt admission is {chunk_ratio:.2f}x steady "
+                  "state (>2x) — prefill chunks are stalling decode",
+                  file=sys.stderr)
+            return 1
         if max_div > 0.25:
             print(f"FAIL: phase spans diverge {100 * max_div:.1f}% from "
                   "wall latency (>25%)", file=sys.stderr)
