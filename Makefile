@@ -12,12 +12,14 @@ test:
 test-all:
 	$(PY) -m pytest tests/ -q -m ""
 
-# Observability fast lane: windowed-metrics/SLO/health/fleet unit tests
-# plus the serve_bench quick gate (phase-sum invariant + windowed-vs-exact
-# SLO attainment <=2%).
+# Observability fast lane: windowed-metrics/SLO/health/fleet/debug unit
+# tests plus the serve_bench quick gate (phase-sum invariant,
+# windowed-vs-exact SLO attainment <=2%, flight-recorder overhead <=2% +
+# forced-dump JSON round-trip).
 obs-quick:
 	$(PY) -m pytest tests/test_timeseries.py tests/test_slo.py \
-	    tests/test_serve_health.py tests/test_fleet.py -q
+	    tests/test_serve_health.py tests/test_fleet.py \
+	    tests/test_obs_debug.py -q
 	$(PY) scripts/serve_bench.py --quick
 
 # Continuous-batching decode gate (sub-30s): real-engine greedy parity vs

@@ -89,7 +89,12 @@ class Checkpointer:
 
 
 def restore_serving_state(
-    directory: str | Path, template_state: Any, *, release_opt_state: bool = True
+    directory: str | Path,
+    template_state: Any,
+    *,
+    release_opt_state: bool = True,
+    memory=None,
+    recorder=None,
 ):
     """Load the newest training checkpoint for the INFERENCE engine.
 
@@ -110,14 +115,37 @@ def restore_serving_state(
     slots' and gradient ring's device buffers before returning — serving
     never reads them, and for an AdamW checkpoint they are 2x the params.
     The reclaimed HBM is what a decode engine's KV-cache pages live in, so
-    leaving them resident would shrink the slot budget for nothing.
+    leaving them resident would shrink the slot budget for nothing. The
+    reclaimed byte count is logged and flows through the memory registry's
+    released ledger (``memory``, default: the process-wide registry), so
+    ``GET /memz`` shows the headroom the release bought; ``recorder`` (a
+    :class:`~..obs.flightrec.FlightRecorder`) gets a ``ckpt_restore``
+    event either way.
     """
+    from distributed_tensorflow_tpu.obs.memory import default_registry
+
     with Checkpointer(directory, use_async=False) as ckpt:
         if ckpt.latest_step() is None:
             raise FileNotFoundError(f"no checkpoint found under {directory}")
         state, step = ckpt.restore_latest(template_state)
+    registry = memory if memory is not None else default_registry()
+    reclaimed = 0
     if release_opt_state:
         for leaf in jax.tree.leaves((state.opt_state, state.grad_buffer)):
             if isinstance(leaf, jax.Array):
+                reclaimed += int(leaf.nbytes)
                 leaf.delete()
+        # Register-then-release: the bytes land in the released ledger, so
+        # /memz shows WHAT was freed, not just a smaller total.
+        registry.register("opt_state", reclaimed)
+        registry.release("opt_state")
+        logger.info(
+            "released optimizer state after restore: %.1f MiB reclaimed",
+            reclaimed / 2**20,
+        )
+    if recorder is not None:
+        recorder.record(
+            "ckpt_restore", step=step, release_opt_state=release_opt_state,
+            reclaimed_bytes=reclaimed,
+        )
     return state.params, state.model_state, step

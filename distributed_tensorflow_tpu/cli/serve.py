@@ -70,6 +70,7 @@ def build_serving_client(cfg, args):
 
     from distributed_tensorflow_tpu.ckpt import restore_serving_state
     from distributed_tensorflow_tpu.cli.train import _make_tx
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
     from distributed_tensorflow_tpu.obs.slo import SloSpec
     from distributed_tensorflow_tpu.parallel.mesh import (
         build_mesh,
@@ -125,7 +126,17 @@ def build_serving_client(cfg, args):
     if pieces.get("param_specs") is not None:
         state_specs = make_state_specs(host_state, tx, pieces["param_specs"])
     template = place_state(host_state, mesh, state_specs)
-    params, model_state, step = restore_serving_state(args.ckpt_dir, template)
+    # The flight recorder exists BEFORE restore so the ckpt_restore event
+    # (step, reclaimed bytes) is the first entry in any later dump.
+    fbuf = getattr(args, "flight_buffer", 2048)
+    recorder = FlightRecorder(
+        capacity=fbuf,
+        enabled=fbuf > 0,
+        dump_dir=getattr(args, "dump_dir", "") or None,
+    )
+    params, model_state, step = restore_serving_state(
+        args.ckpt_dir, template, recorder=recorder
+    )
     logger.info(
         "restored %s step %d for serving (mesh %s)",
         cfg.name, step, dict(mesh.shape),
@@ -212,6 +223,8 @@ def build_serving_client(cfg, args):
         slo=slo,
         admission="flush" if getattr(args, "flush_admission", False)
         else "continuous",
+        recorder=recorder,
+        warmup_ready_fraction=getattr(args, "warmup_ready_fraction", 1.0),
     )
     return client, make_payload
 
@@ -343,6 +356,23 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--trace-buffer", type=int, default=4096,
                         help="span ring-buffer size (0 disables tracing: "
                         "every span call becomes a cheap no-op)")
+    # Black-box flight recorder (see OBS.md "Flight recorder"): a bounded
+    # ring of structured lifecycle events, dumped with a full observability
+    # snapshot on engine failure / paging SLO burn / POST /debugz/dump.
+    parser.add_argument("--flight-buffer", type=int, default=2048,
+                        help="flight-recorder event ring size (0 disables "
+                        "the recorder: every record call becomes a cheap "
+                        "no-op and /debugz/dump answers 503)")
+    parser.add_argument("--dump-dir", default="",
+                        help="where flight-recorder dumps land as "
+                        "timestamped JSON (empty: POST /debugz/dump "
+                        "returns the snapshot inline; automatic triggers "
+                        "have nowhere to write and are skipped)")
+    parser.add_argument("--warmup-ready-fraction", type=float, default=1.0,
+                        help="/healthz reports 'starting' (HTTP 503) until "
+                        "this fraction of the AOT executable grid is "
+                        "compiled; routers should withhold traffic until "
+                        "ready (see DEPLOY.md \"Warmup-gated readiness\")")
     parser.add_argument("--selftest", type=int, default=0,
                         help="serve N synthetic requests in-process and "
                         "exit (no HTTP socket)")
@@ -381,8 +411,8 @@ def main(argv: list[str] | None = None):
         )
         logger.info(
             "ready on http://%s:%d (POST /v1/%s; GET /healthz /sloz "
-            "/statusz /tracez /metrics?format=prom, POST /profilez "
-            "/drainz)",
+            "/statusz /memz /compilez /tracez /metrics?format=prom, "
+            "POST /profilez /drainz /debugz/dump)",
             *server.server_address,
             "classify" if hasattr(client.engine, "image_shape")
             else "generate" if hasattr(client.engine, "decode")

@@ -944,6 +944,20 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         if args.profile_dir
         else contextlib.nullcontext()
     )
+    # Flight recorder (--dump-dir, obs/flightrec.py): train records almost
+    # nothing per step (the hot loop stays clean), but an unhandled failure
+    # dumps the ckpt/memz/tracer context for postmortem.
+    recorder = None
+    dump_dir = getattr(args, "dump_dir", "") or ""
+    if dump_dir:
+        from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+        from distributed_tensorflow_tpu.obs.memory import default_registry
+
+        recorder = FlightRecorder(dump_dir=dump_dir)
+        recorder.attach(
+            memz_fn=default_registry().snapshot,
+            tracer_fn=tracer.summary if tracer is not None else None,
+        )
     try:
         with profile_cm as win:
             step_fn = step
@@ -974,6 +988,11 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
             )
         if ckpt is not None and ckpt.latest_step() != int(state.step):
             ckpt.save(int(state.step), state, force=True)
+    except Exception as e:
+        if recorder is not None:
+            recorder.record("engine_failure", error=type(e).__name__)
+            recorder.dump("train_failure", force=True)
+        raise
     finally:
         if ckpt is not None:
             ckpt.close()
@@ -1097,6 +1116,11 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--trace-buffer", type=int, default=4096,
                         help="span ring-buffer size for --trace-dir (the "
                         "export holds the most recent N spans)")
+    parser.add_argument("--dump-dir", default="",
+                        help="flight-recorder dump directory: an unhandled "
+                        "training failure writes one timestamped JSON with "
+                        "the event ring + memory/tracer digests (see "
+                        "OBS.md \"Flight recorder\"; empty = disabled)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--rng-impl",

@@ -12,6 +12,10 @@ from distributed_tensorflow_tpu.obs.export import (  # noqa: F401
     PROM_CONTENT_TYPE,
     prometheus_text,
 )
+from distributed_tensorflow_tpu.obs.flightrec import (  # noqa: F401
+    NULL_RECORDER,
+    FlightRecorder,
+)
 from distributed_tensorflow_tpu.obs.fleet import (  # noqa: F401
     HostBeacon,
     StepTimeline,
@@ -23,6 +27,12 @@ from distributed_tensorflow_tpu.obs.fleet import (  # noqa: F401
 from distributed_tensorflow_tpu.obs.health import (  # noqa: F401
     HealthTracker,
     http_status,
+)
+from distributed_tensorflow_tpu.obs.memory import (  # noqa: F401
+    MemoryRegistry,
+    default_registry,
+    reset_default_registry,
+    tree_nbytes,
 )
 from distributed_tensorflow_tpu.obs.metrics import (  # noqa: F401
     Counter,

@@ -126,9 +126,13 @@ def _split_layout_labels(snapshot: dict, value_key: str) -> list[tuple[dict, flo
     return out
 
 
-def serve_families(metrics, slo=None, health=None) -> list[Family]:
-    """Every ``ServeMetrics`` family (plus SLO + health when given) as
-    exposition rows."""
+def serve_families(
+    metrics, slo=None, health=None, memory=None, grid=None
+) -> list[Family]:
+    """Every ``ServeMetrics`` family (plus SLO + health + memory + compile
+    grid when given) as exposition rows. ``memory`` is a
+    :class:`~.memory.MemoryRegistry`; ``grid`` is an engine
+    ``grid_status()`` digest dict."""
     m = metrics
     fams = [
         Family("serve_requests_total", "counter",
@@ -309,12 +313,61 @@ def serve_families(metrics, slo=None, health=None) -> list[Family]:
                    "1 when /healthz answers 200")
             .add(1 if state in SERVING_STATES else 0)
         )
+
+    if memory is not None:
+        snap = memory.snapshot()
+        hbm = Family("hbm_reserved_bytes", "gauge",
+                     "accounted device-memory reservation per component")
+        for comp, nbytes in snap["components"].items():
+            hbm.add(nbytes, {"component": comp})
+        fams.append(hbm)
+        released = Family("hbm_released_bytes_total", "counter",
+                          "device bytes released per component since boot")
+        for comp, nbytes in snap["released"].items():
+            released.add(nbytes, {"component": comp})
+        fams.append(released)
+        in_use = Family("hbm_device_bytes_in_use", "gauge",
+                        "backend-reported bytes_in_use per local device")
+        limit = Family("hbm_device_bytes_limit", "gauge",
+                       "backend-reported byte limit per local device")
+        for row in snap["devices"]:
+            if row.get("reported"):
+                lbl = {"device": str(row["device"]),
+                       "platform": row["platform"]}
+                in_use.add(row["bytes_in_use"], lbl)
+                limit.add(row["bytes_limit"], lbl)
+        fams.extend([in_use, limit])
+
+    if grid is not None:
+        cells = Family("serve_compile_cells", "gauge",
+                       "AOT grid cells by compile state")
+        cells.add(grid["cells_compiled"], {"state": "compiled"})
+        cells.add(grid["cells_failed"], {"state": "failed"})
+        cells.add(
+            max(grid["cells_total"] - grid["cells_compiled"]
+                - grid["cells_failed"], 0),
+            {"state": "pending"},
+        )
+        fams.append(cells)
+        fams.append(
+            Family("serve_compile_seconds_total", "counter",
+                   "cumulative AOT grid compile wall time")
+            .add(grid["compile_seconds_total"])
+        )
+        fams.append(
+            Family("serve_grid_warm_fraction", "gauge",
+                   "fraction of planned AOT grid cells compiled")
+            .add(grid["warm_fraction"])
+        )
     return fams
 
 
-def prometheus_text(metrics, slo=None, health=None) -> str:
+def prometheus_text(metrics, slo=None, health=None, memory=None,
+                    grid=None) -> str:
     """The ``GET /metrics?format=prom`` body."""
-    return render(serve_families(metrics, slo=slo, health=health))
+    return render(serve_families(
+        metrics, slo=slo, health=health, memory=memory, grid=grid
+    ))
 
 
 #: content type for the exposition reply

@@ -64,6 +64,9 @@ class HealthTracker:
         slo=None,
         saturation_window_s: float = 10.0,
         clock=time.monotonic,
+        warmup_fn=None,
+        warmup_target: float = 1.0,
+        recorder=None,
     ):
         self._lock = threading.Lock()
         self._state = "starting"
@@ -72,6 +75,13 @@ class HealthTracker:
         self._slo = slo
         self._saturation_window_s = float(saturation_window_s)
         self._clock = clock
+        # Warmup-gated readiness: ``warmup_fn() -> float`` reports the AOT
+        # grid's warm fraction; while the tracker is ``starting`` a probe
+        # auto-promotes to ready once the fraction reaches the target (the
+        # docs/DEPLOY.md router contract: starting until grid warm).
+        self._warmup_fn = warmup_fn
+        self._warmup_target = float(warmup_target)
+        self._recorder = recorder
 
     # ------------------------------------------------- explicit lifecycle
 
@@ -81,7 +91,9 @@ class HealthTracker:
                 raise ValueError(
                     f"invalid health transition {self._state} -> {to}"
                 )
-            self._state = to
+            was, self._state = self._state, to
+        if self._recorder is not None:
+            self._recorder.record("health_transition", state=to, was=was)
 
     def mark_ready(self) -> None:
         self._transition("ready")
@@ -91,8 +103,10 @@ class HealthTracker:
 
     def mark_closed(self) -> None:
         with self._lock:
-            if self._state != "closed":
-                self._state = "closed"  # always legal, idempotent
+            was, self._state = self._state, "closed"  # always legal,
+        if was != "closed" and self._recorder is not None:  # idempotent
+            self._recorder.record("health_transition", state="closed",
+                                  was=was)
 
     @property
     def lifecycle(self) -> str:
@@ -128,6 +142,26 @@ class HealthTracker:
         if status.get("closed") and base not in ("closed",):
             base = "closed"  # stack closed underneath us (e.g. bare
             # batcher.close()) — report it even without mark_closed()
+        if base == "starting" and self._warmup_fn is not None:
+            frac = float(self._warmup_fn())
+            detail["warm_fraction"] = frac
+            if frac >= self._warmup_target:
+                # Grid warm: auto-promote at probe time (guarded — a racing
+                # probe or an explicit mark_ready may have beaten us).
+                with self._lock:
+                    promote = self._state == "starting"
+                    if promote:
+                        self._state = "ready"
+                if promote and self._recorder is not None:
+                    self._recorder.record("health_transition",
+                                          state="ready", was="starting")
+                base = "ready"
+            else:
+                detail["reason"] = (
+                    f"warming: grid {frac:.0%} compiled "
+                    f"(target {self._warmup_target:.0%})"
+                )
+                return base, detail
         if base in ("closed", "draining", "starting"):
             return base, detail
         reason = self._saturation(status, now)

@@ -1,0 +1,218 @@
+"""Device-memory accounting: who is occupying HBM, by name.
+
+The serving and training stacks allocate a handful of large, long-lived
+device residencies — model params, AdamW slots, the decode engine's
+slot-table KV cache, the prefix-cache page pool, the stale-mode gradient
+ring, host staging buffers — and the contention between them is exactly
+what ``ckpt.restore_serving_state(release_opt_state=True)`` exists to
+manage. This module makes that contention *visible*: components register
+named byte reservations at allocation time (sizes computed from array
+shapes, so the accounting costs a dict write, never a device sync), and
+the registry reconciles the accounted total against
+``jax.local_devices()[i].memory_stats()`` where the backend reports it.
+
+Degradation contract: ``memory_stats()`` is a TPU/GPU feature — CPU
+backends return ``None`` or raise. The registry treats every per-device
+failure as "unreported" and falls back to accounted-only totals, so
+``GET /memz`` answers on every backend and the 10%%-reconciliation check
+in ISSUE acceptance only applies where the runtime actually reports.
+
+Threading: one small lock orders every method; no call ever re-enters a
+caller's lock (engines call ``register`` while holding their own buffer
+locks — the registry must never call back out).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "MemoryRegistry",
+    "default_registry",
+    "reset_default_registry",
+    "tree_nbytes",
+]
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of every array leaf in ``tree`` (jax or numpy — anything
+    with ``.nbytes``). Shape-derived: never materializes or syncs."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+class MemoryRegistry:
+    """Named byte reservations + device reconciliation.
+
+    ``register`` SETS a component's reservation (idempotent re-registration
+    — a rebuilt engine overwrites its dead predecessor's entry instead of
+    double counting); ``add`` grows one (staging buffers accrete);
+    ``release`` removes one, accumulating the freed bytes into a
+    ``released`` ledger so a restore that drops the AdamW slots leaves an
+    auditable trail in ``/memz`` rather than just a smaller number.
+
+    ``devices_fn`` defaults to ``jax.local_devices`` and exists so tests
+    can reconcile against stub devices without a real backend.
+    """
+
+    # Shared mutable state; every access is ordered by self._lock (the
+    # sanitize_races soak can watch these when a test wraps an instance).
+    _RACETRACE_ATTRS = ("_reserved", "_released")
+
+    def __init__(self, devices_fn=None):
+        self._lock = threading.Lock()
+        self._reserved: dict[str, int] = {}
+        self._released: dict[str, int] = {}
+        self._devices_fn = devices_fn
+
+    # -------------------------------------------------------- bookkeeping
+
+    def register(self, component: str, nbytes: int) -> None:
+        """Set ``component``'s reservation to ``nbytes`` (absolute)."""
+        with self._lock:
+            self._reserved[str(component)] = int(nbytes)
+
+    def add(self, component: str, nbytes: int) -> None:
+        """Grow ``component``'s reservation by ``nbytes``."""
+        with self._lock:
+            key = str(component)
+            self._reserved[key] = self._reserved.get(key, 0) + int(nbytes)
+
+    def register_tree(self, component: str, tree) -> int:
+        """``register`` with bytes summed from an array pytree; returns the
+        byte count so callers can log it."""
+        n = tree_nbytes(tree)
+        self.register(component, n)
+        return n
+
+    def release(self, component: str, nbytes: int | None = None) -> int:
+        """Drop ``component``'s reservation (or ``nbytes`` of it) and
+        record the freed bytes in the ``released`` ledger. Returns the
+        bytes actually released (0 for an unknown component)."""
+        with self._lock:
+            key = str(component)
+            held = self._reserved.get(key, 0)
+            freed = held if nbytes is None else min(int(nbytes), held)
+            if freed <= 0 and held == 0:
+                return 0
+            remaining = held - freed
+            if remaining > 0:
+                self._reserved[key] = remaining
+            else:
+                self._reserved.pop(key, None)
+            self._released[key] = self._released.get(key, 0) + freed
+            return freed
+
+    def components(self) -> dict[str, int]:
+        with self._lock:
+            return dict(sorted(self._reserved.items()))
+
+    def accounted_bytes(self) -> int:
+        with self._lock:
+            return sum(self._reserved.values())
+
+    # ----------------------------------------------------- reconciliation
+
+    def _devices(self):
+        if self._devices_fn is not None:
+            return self._devices_fn()
+        import jax
+
+        return jax.local_devices()
+
+    def device_stats(self) -> list[dict]:
+        """One row per local device: ``memory_stats()`` where the backend
+        reports it, ``reported: False`` where it doesn't (CPU). Failures
+        degrade per device — one bad device never hides the others."""
+        rows = []
+        try:
+            devices = self._devices()
+        except Exception:  # noqa: BLE001 — no backend at all: no rows
+            return rows
+        for i, d in enumerate(devices):
+            row = {
+                "device": i,
+                "platform": getattr(d, "platform", "unknown"),
+                "reported": False,
+            }
+            stats_fn = getattr(d, "memory_stats", None)
+            if callable(stats_fn):
+                try:
+                    stats = stats_fn()
+                except Exception:  # noqa: BLE001 — backend quirk != outage
+                    stats = None
+                if stats:
+                    row["reported"] = True
+                    row["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+                    limit = stats.get(
+                        "bytes_limit", stats.get("bytes_reservable_limit", 0)
+                    )
+                    row["bytes_limit"] = int(limit or 0)
+            rows.append(row)
+        return rows
+
+    def reconcile(self) -> dict:
+        """Accounted vs backend-reported totals + a headroom estimate.
+
+        ``ratio`` is accounted/reported (None when nothing reports — the
+        CPU fallback); ``headroom_bytes`` is limit - in_use summed over
+        reporting devices, or None."""
+        devices = self.device_stats()
+        reporting = [d for d in devices if d["reported"]]
+        accounted = self.accounted_bytes()
+        out = {
+            "accounted_bytes": accounted,
+            "devices_reporting": len(reporting),
+            "devices_total": len(devices),
+            "reported_bytes_in_use": None,
+            "headroom_bytes": None,
+            "ratio": None,
+        }
+        if reporting:
+            in_use = sum(d["bytes_in_use"] for d in reporting)
+            limit = sum(d["bytes_limit"] for d in reporting)
+            out["reported_bytes_in_use"] = in_use
+            if limit:
+                out["headroom_bytes"] = max(limit - in_use, 0)
+            if in_use:
+                out["ratio"] = accounted / in_use
+        return out
+
+    def snapshot(self) -> dict:
+        """The ``GET /memz`` body: per-component reservations, the freed
+        ledger, per-device stats, and the reconciliation digest."""
+        with self._lock:
+            reserved = dict(sorted(self._reserved.items()))
+            released = dict(sorted(self._released.items()))
+        return {
+            "components": reserved,
+            "released": released,
+            "devices": self.device_stats(),
+            **self.reconcile(),
+        }
+
+
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT: MemoryRegistry | None = None
+
+
+def default_registry() -> MemoryRegistry:
+    """Process-wide registry: engines/ckpt/train register here unless a
+    caller supplies their own, so one serving process's ``/memz`` sees
+    every footprint without plumbing a handle through each layer."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = MemoryRegistry()
+        return _DEFAULT
+
+
+def reset_default_registry() -> None:
+    """Swap in a fresh default (tests isolate their accounting with it)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = MemoryRegistry()

@@ -113,10 +113,15 @@ class SloTracker:
     """
 
     def __init__(self, metrics, spec: SloSpec | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, recorder=None):
         self.metrics = metrics
         self.spec = spec or SloSpec()
         self._clock = clock
+        # Flight-recorder hookup: verdict() is pull-based (probes/exports
+        # call it), so verdict CHANGES are detected here — each one logs an
+        # slo_verdict event and a flip to "page" trips a rate-limited dump.
+        self._recorder = recorder
+        self._last_verdict = "ok"
 
     # ------------------------------------------------------------ queries
 
@@ -209,4 +214,10 @@ class SloTracker:
             vs.append(_verdict(spec, self._latency_burns(now)))
         if spec.availability_target:
             vs.append(_verdict(spec, self._availability_burns(now)))
-        return worst(vs)
+        v = worst(vs)
+        if self._recorder is not None and v != self._last_verdict:
+            was, self._last_verdict = self._last_verdict, v
+            self._recorder.record("slo_verdict", verdict=v, was=was)
+            if v == "page":
+                self._recorder.trigger("slo_page")
+        return v

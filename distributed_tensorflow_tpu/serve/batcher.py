@@ -39,6 +39,7 @@ from collections import deque
 from collections.abc import Callable, Sequence
 from concurrent.futures import Future
 
+from distributed_tensorflow_tpu.obs.flightrec import NULL_RECORDER
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
 from distributed_tensorflow_tpu.obs.trace import NULL_TRACER
 
@@ -111,11 +112,13 @@ class DynamicBatcher:
         fetch: Callable | None = None,
         bucket_for: Callable | None = None,
         tracer=None,
+        recorder=None,
         layout: str = "",
     ):
         self.config = config or BatcherConfig()
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
         # The engine's mesh-layout label; keys the per-layout phase
         # histograms (ServeMetrics.layout_phase). Empty = unlabelled.
         self._layout = layout
@@ -170,6 +173,9 @@ class DynamicBatcher:
                 metrics.rejected_by_cause.inc("closed")
                 if metrics.windowed:
                     metrics.bad_w.add(1.0)
+                self.recorder.record(
+                    "request_reject", request_id, cause="closed"
+                )
                 raise RuntimeError("batcher is closed")
             if self._count >= self.config.max_queue:
                 metrics.rejected.inc()
@@ -180,6 +186,10 @@ class DynamicBatcher:
                 self.tracer.instant(
                     "rejected", "serve", request_id=request_id,
                     cause="backpressure", queue_depth=self._count,
+                )
+                self.recorder.record(
+                    "request_reject", request_id, cause="backpressure",
+                    queue_depth=self._count,
                 )
                 # One flush window, floored at 1 ms so a zero-delay config
                 # still hands clients a usable (non-zero) retry hint.
@@ -195,6 +205,7 @@ class DynamicBatcher:
             self._cv.notify_all()
         if metrics.windowed:
             metrics.requests_w.add(1.0)
+        self.recorder.record("request_admit", request_id)
         return pending.future
 
     def status(self) -> dict:
@@ -284,12 +295,16 @@ class DynamicBatcher:
                 "engine_failure", "serve", request_id=p.request_id,
                 error=type(exc).__name__,
             )
+            self.recorder.record(
+                "engine_failure", p.request_id, error=type(exc).__name__,
+            )
             if not p.future.cancelled():
                 p.future.set_exception(exc)
         logger.warning(
             "batch of %d failed (%s): request_ids=%s",
             len(batch), type(exc).__name__, [p.request_id for p in batch],
         )
+        self.recorder.trigger("engine_failure")
 
     def _deliver(self, batch: list[_Pending], results,
                  marks: list[tuple[str, float]] = (), final_phase="fetch",
@@ -368,6 +383,12 @@ class DynamicBatcher:
             if not p.future.cancelled():
                 p.future.phases = phases
                 p.future.set_result(r)
+        if self.recorder.enabled:
+            for p in batch:
+                self.recorder.record(
+                    "request_complete", p.request_id,
+                    latency_ms=round((now - p.t_enqueue) * 1e3, 3),
+                )
 
     def _loop(self):
         while True:
@@ -489,6 +510,7 @@ class _Slot:
         "pending", "gen", "prompt_len", "length", "max_new", "eos_id",
         "temperature", "seed", "tokens", "n_dispatched", "t_first",
         "t_last_tok", "prefilling", "chunk_pos", "cached_len", "chain",
+        "slot_id",
     )
 
     def __init__(self, pending: _Pending, gen: int, payload: dict,
@@ -514,6 +536,7 @@ class _Slot:
         self.chunk_pos = 0
         self.cached_len = 0
         self.chain = None
+        self.slot_id = -1  # table index, stamped at admission (flight rec)
 
 
 class ContinuousBatcher:
@@ -576,6 +599,7 @@ class ContinuousBatcher:
         *,
         admission: str = "continuous",
         tracer=None,
+        recorder=None,
         layout: str = "",
     ):
         if admission not in ("continuous", "flush"):
@@ -585,6 +609,7 @@ class ContinuousBatcher:
         self.config = config or BatcherConfig()
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
         self._layout = layout or getattr(engine, "layout", "")
         self._engine = engine
         self._admission = admission
@@ -602,6 +627,10 @@ class ContinuousBatcher:
         self._pool = (
             getattr(engine, "prefix_cache", None) if self._chunked else None
         )
+        if self._pool is not None and self.recorder.enabled:
+            # Evictions happen inside the pool's allocator; hand it the
+            # recorder so prefix_evict events land in the same ring.
+            self._pool.recorder = self.recorder
         self._req_ids = itertools.count()
         self._gens = itertools.count(1)
         self._cv = threading.Condition()
@@ -637,6 +666,9 @@ class ContinuousBatcher:
                 metrics.rejected_by_cause.inc("closed")
                 if metrics.windowed:
                     metrics.bad_w.add(1.0)
+                self.recorder.record(
+                    "request_reject", request_id, cause="closed"
+                )
                 raise RuntimeError("batcher is closed")
             if self._count >= self.config.max_queue:
                 metrics.rejected.inc()
@@ -647,6 +679,10 @@ class ContinuousBatcher:
                 self.tracer.instant(
                     "rejected", "serve", request_id=request_id,
                     cause="backpressure", queue_depth=self._count,
+                )
+                self.recorder.record(
+                    "request_reject", request_id, cause="backpressure",
+                    queue_depth=self._count,
                 )
                 exc = Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
                 exc.request_id = request_id
@@ -660,6 +696,7 @@ class ContinuousBatcher:
             self._cv.notify_all()
         if metrics.windowed:
             metrics.requests_w.add(1.0)
+        self.recorder.record("request_admit", request_id)
         return pending.future
 
     def status(self) -> dict:
@@ -674,6 +711,13 @@ class ContinuousBatcher:
                 "max_in_flight": self.config.max_in_flight,
                 "slots": len(self._slots),
                 "slots_active": self._n_active,
+                # Device bytes the active occupants' slot-table pages pin
+                # (slots_active x the engine's per-slot share) — the same
+                # number /memz accounts under kv_slot_cache, scaled to
+                # live occupancy so the two surfaces agree.
+                "kv_active_bytes": self._n_active * getattr(
+                    self._engine, "slot_page_bytes", 0
+                ),
             }
             if self._pool is not None:
                 # KV-pressure digest for /statusz + the fleet view: pool
@@ -771,6 +815,7 @@ class ContinuousBatcher:
                             slot.chunk_pos = slot.cached_len
                         else:
                             slot.n_dispatched = 1  # prefill's first token
+                        slot.slot_id = slot_id
                         self._slots[slot_id] = slot
                         self._n_active += 1
                         admissions.append((slot_id, slot))
@@ -837,7 +882,7 @@ class ContinuousBatcher:
                 self._n_active -= 1
                 if self._pool is not None and s.chain is not None:
                     self._pool.release(s.chain)  # idempotent unpin
-                victims.append(s.pending)
+                victims.append((slot_id, s.pending))
             metrics.slots_active.set(self._n_active)
             self._cv.notify_all()
         if not victims:
@@ -846,17 +891,24 @@ class ContinuousBatcher:
         metrics.rejected_by_cause.inc("engine_failure", len(victims))
         if metrics.windowed:
             metrics.bad_w.add(float(len(victims)))
-        for p in victims:
+        for slot_id, p in victims:
             self.tracer.instant(
                 "engine_failure", "serve", request_id=p.request_id,
                 error=type(exc).__name__,
             )
+            self.recorder.record(
+                "engine_failure", p.request_id, slot=slot_id,
+                error=type(exc).__name__,
+            )
+            self.recorder.record("slot_free", p.request_id, slot=slot_id,
+                                 cause="engine_failure")
             if not p.future.cancelled():
                 p.future.set_exception(exc)
         logger.warning(
             "decode dispatch failed (%s): request_ids=%s",
-            type(exc).__name__, [p.request_id for p in victims],
+            type(exc).__name__, [p.request_id for _, p in victims],
         )
+        self.recorder.trigger("engine_failure")
 
     def _loop(self):
         engine = self._engine
@@ -869,6 +921,18 @@ class ContinuousBatcher:
             if admissions:
                 self.metrics.batches.inc()
                 self.metrics.batch_occupancy.observe(len(admissions))
+                if self.recorder.enabled:
+                    # Outside _cv: _take_work already published the slots.
+                    for i, s in admissions:
+                        self.recorder.record(
+                            "slot_alloc", s.pending.request_id,
+                            slot=i, prompt_len=s.prompt_len,
+                        )
+                        if s.cached_len:
+                            self.recorder.record(
+                                "prefix_hit", s.pending.request_id,
+                                slot=i, cached_tokens=s.cached_len,
+                            )
             if admissions and not self._chunked:
                 self._inflight_sem.acquire()
                 tags = [(i, s.gen) for i, s in admissions]
@@ -1033,6 +1097,15 @@ class ContinuousBatcher:
                     "prompt_len": s.prompt_len,
                     "bucket": self._engine.bucket_for(s.prompt_len),
                 })
+        if self.recorder.enabled:
+            for s in finished:
+                self.recorder.record("slot_free", s.pending.request_id,
+                                     slot=s.slot_id)
+                self.recorder.record(
+                    "request_complete", s.pending.request_id,
+                    slot=s.slot_id, n_tokens=len(s.tokens),
+                    latency_ms=round((now - s.pending.t_enqueue) * 1e3, 3),
+                )
 
     def _completion_loop(self):
         engine, metrics = self._engine, self.metrics

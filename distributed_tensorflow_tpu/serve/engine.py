@@ -54,6 +54,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.models.causal_lm import sample_tokens
+from distributed_tensorflow_tpu.obs.memory import default_registry, tree_nbytes
 from distributed_tensorflow_tpu.parallel.mesh import (
     batch_sharding,
     build_mesh,
@@ -156,6 +157,19 @@ class InFlightBatch:
     t_got: float = 0.0
 
 
+@dataclasses.dataclass
+class CompileRecord:
+    """One AOT grid-cell compile: what it was, what it cost, whether it
+    landed. ``size_bytes`` is the executable's generated-code size where
+    the backend's ``memory_analysis()`` reports it, else None."""
+
+    key: str
+    seconds: float
+    size_bytes: int | None = None
+    ok: bool = True
+    error: str | None = None
+
+
 class _AotEngine:
     """Shared AOT plumbing: compile-per-shape at startup, place-and-call.
 
@@ -163,9 +177,21 @@ class _AotEngine:
     ladder, per-tier batch shardings, the staging-buffer pool, and the
     per-dispatch metrics recording (``self.metrics`` is wired by
     :class:`serve.server.Client`; it stays ``None`` for bare engines).
+
+    Every grid-cell compile routes through :meth:`_compile_cell`, which
+    times it into a :class:`CompileRecord`; :meth:`grid_status` aggregates
+    the records into the ``GET /compilez`` digest and the warm fraction
+    the warmup-gated readiness contract reads. Large device residencies
+    (params, KV caches, staging buffers) register with ``self.memory`` —
+    the process-wide :class:`~..obs.memory.MemoryRegistry` unless a caller
+    injects its own — so ``GET /memz`` accounts this engine's footprint.
     """
 
-    def __init__(self, mesh, max_batch: int, batch_tiers=None):
+    # Grid records and the staging pool are written by worker threads and
+    # read by HTTP handlers; _grid_lock / _buf_lock order every access.
+    _RACETRACE_ATTRS = ("_buf_pool", "_compile_records", "_cells_planned")
+
+    def __init__(self, mesh, max_batch: int, batch_tiers=None, memory=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.mesh = mesh if mesh is not None else build_mesh({"data": -1})
@@ -173,6 +199,7 @@ class _AotEngine:
         self.max_batch = max_batch
         self.batch_tiers = _normalize_tiers(batch_tiers, max_batch)
         self.metrics = None
+        self.memory = memory if memory is not None else default_registry()
         self._param_sharding = replicated_sharding(self.mesh)
         self._tier_sharding = {
             t: _batch_sharding_or_replicated(self.mesh, t)
@@ -180,6 +207,71 @@ class _AotEngine:
         }
         self._buf_lock = threading.Lock()
         self._buf_pool: dict[tuple, list[tuple]] = {}
+        self._grid_lock = threading.Lock()
+        self._compile_records: list[CompileRecord] = []
+        self._cells_planned = 0
+
+    # -- AOT grid observability ----------------------------------------
+
+    def _plan_cells(self, n: int) -> None:
+        """Announce ``n`` upcoming grid cells BEFORE compiling them, so a
+        mid-warmup ``grid_status`` reports a warm fraction < 1 instead of
+        pretending the cells it has not seen yet do not exist."""
+        with self._grid_lock:
+            self._cells_planned += int(n)
+
+    def _compile_cell(self, key: str, build):
+        """Run one grid-cell compile (``build`` returns the Compiled
+        object), recording wall time, executable size, and failure. A
+        failed compile records then re-raises — startup still dies loudly,
+        but the record survives into any dump a wrapper takes."""
+        t0 = time.monotonic()
+        try:
+            exe = build()
+        except Exception as e:
+            with self._grid_lock:
+                self._compile_records.append(CompileRecord(
+                    key=key, seconds=time.monotonic() - t0, ok=False,
+                    error=f"{type(e).__name__}: {e}",
+                ))
+            raise
+        seconds = time.monotonic() - t0
+        size = None
+        try:
+            ma = exe.memory_analysis()
+            size = int(getattr(ma, "generated_code_size_in_bytes", 0)) or None
+        except Exception:  # noqa: BLE001 — size is best-effort per backend
+            size = None
+        with self._grid_lock:
+            self._compile_records.append(
+                CompileRecord(key=key, seconds=seconds, size_bytes=size)
+            )
+        return exe
+
+    def grid_status(self) -> dict:
+        """The ``GET /compilez`` digest: cell counts, cumulative compile
+        seconds, warm fraction, the coldest (most expensive) cell, and the
+        full per-cell record list."""
+        with self._grid_lock:
+            records = list(self._compile_records)
+            planned = self._cells_planned
+        compiled = sum(1 for r in records if r.ok)
+        failed = len(records) - compiled
+        total = max(planned, len(records))
+        ok_records = [r for r in records if r.ok]
+        coldest = max(ok_records, key=lambda r: r.seconds, default=None)
+        return {
+            "cells_total": total,
+            "cells_compiled": compiled,
+            "cells_failed": failed,
+            "compile_seconds_total": sum(r.seconds for r in records),
+            "warm_fraction": (compiled / total) if total else 1.0,
+            "coldest_cell": (
+                {"key": coldest.key, "seconds": coldest.seconds}
+                if coldest is not None else None
+            ),
+            "cells": [dataclasses.asdict(r) for r in records],
+        }
 
     def tier_for(self, n: int) -> int:
         """Smallest compiled batch tier holding ``n`` rows."""
@@ -209,7 +301,11 @@ class _AotEngine:
             pool = self._buf_pool.get(key)
             if pool:
                 return pool.pop()
-        return make()
+        buffers = make()
+        # Fresh allocation: grow the staging-buffer reservation. Outside
+        # _buf_lock — the registry has its own lock and must never nest.
+        self.memory.add("staging_buffers", tree_nbytes(buffers))
+        return buffers
 
     def _give_buffers(self, key: tuple, buffers: tuple) -> None:
         with self._buf_lock:
@@ -328,8 +424,9 @@ class BertInferenceEngine(_AotEngine):
         max_batch: int = 8,
         batch_tiers: tuple[int, ...] | None = None,
         return_logits: bool = False,
+        memory=None,
     ):
-        super().__init__(mesh, max_batch, batch_tiers)
+        super().__init__(mesh, max_batch, batch_tiers, memory=memory)
         tp = self.mesh.shape.get("model", 1)
         ep = self.mesh.shape.get("expert", 1)
         pp = self.mesh.shape.get("pipeline", 1)
@@ -366,26 +463,31 @@ class BertInferenceEngine(_AotEngine):
         else:
             self._param_specs = None
         self.params = self._place(params)
+        self.memory.register_tree("bert_params", self.params)
         # AOT-compile one executable per (batch tier, sequence bucket) NOW:
         # startup pays every trace/compile, the request path pays none (jit
         # cache lookups included — these are Compiled objects, not jit
         # wrappers). A partial flush dispatches at the smallest tier that
         # fits instead of padding to max_batch.
         self._compiled = {}
+        self._plan_cells(len(self.batch_tiers) * len(self.buckets))
         for T in self.batch_tiers:
             fwd = self._tier_forward(T)
             for L in self.buckets:
                 b = (T, L)
-                self._compiled[T, L] = (
-                    jax.jit(fwd)
-                    .lower(
-                        self.params,
-                        self._struct(b, jnp.int32, T),
-                        self._struct(b, jnp.bool_, T),
-                        self._struct(b, jnp.int32, T),
-                        self._struct(b, jnp.int32, T),
-                    )
-                    .compile()
+                self._compiled[T, L] = self._compile_cell(
+                    f"bert/{self.layout}/t{T}/b{L}",
+                    lambda fwd=fwd, b=b, T=T: (
+                        jax.jit(fwd)
+                        .lower(
+                            self.params,
+                            self._struct(b, jnp.int32, T),
+                            self._struct(b, jnp.bool_, T),
+                            self._struct(b, jnp.int32, T),
+                            self._struct(b, jnp.int32, T),
+                        )
+                        .compile()
+                    ),
                 )
         logger.info(
             "BERT engine ready: layout=%s buckets=%s tiers=%s (%d executables)",
@@ -784,10 +886,12 @@ class CausalLMEngine(_AotEngine):
         prefix_cache_mb: float = 0.0,
         block_tokens: int = 16,
         prefill_chunk: int = 0,
+        memory=None,
     ):
         if slots < 1:
             raise ValueError(f"need at least one cache slot, got {slots}")
-        super().__init__(mesh, min(max_batch, slots), batch_tiers)
+        super().__init__(mesh, min(max_batch, slots), batch_tiers,
+                         memory=memory)
         tp = self.mesh.shape.get("model", 1)
         ep = self.mesh.shape.get("expert", 1)
         pp = self.mesh.shape.get("pipeline", 1)
@@ -840,6 +944,15 @@ class CausalLMEngine(_AotEngine):
         self._last_token = jax.device_put(
             jnp.zeros((slots,), jnp.int32), self._rep
         )
+        self.memory.register_tree("lm_params", self.params)
+        self.memory.register(
+            "kv_slot_cache", self._cache_k.nbytes + self._cache_v.nbytes
+        )
+        # Per-slot share of the slot-table KV cache: the batcher multiplies
+        # this by slots_active so /statusz and /memz agree on active bytes.
+        self.slot_page_bytes = (
+            self._cache_k.nbytes + self._cache_v.nbytes
+        ) // slots
 
         # Prefix-cache / chunked-prefill plumbing. Legacy mode (both knobs
         # 0) compiles the original monolithic prefill grid; chunked mode
@@ -880,6 +993,9 @@ class CausalLMEngine(_AotEngine):
             self._pool_v = jax.device_put(
                 jnp.zeros(pool_shape, cfg.dtype), self._cache_sharding
             )
+            self.memory.register(
+                "kv_prefix_pool", self._pool_k.nbytes + self._pool_v.nbytes
+            )
         else:
             self.prefill_chunk_size = 0
 
@@ -890,86 +1006,103 @@ class CausalLMEngine(_AotEngine):
         self._prefill_compiled = {}
         self._chunk_compiled = {}
         if not self._chunked_mode:
+            self._plan_cells(len(self.batch_tiers) * len(self.buckets) + 1)
             for T in self.batch_tiers:
                 fn = self._wrap(_make_causal_prefill(self.model), n_batch=6)
                 for L in self.buckets:
-                    self._prefill_compiled[T, L] = (
-                        jax.jit(fn, donate_argnums=(1, 2, 3))
-                        .lower(
-                            self.params,
-                            self._cache_struct(cache_shape, cfg.dtype),
-                            self._cache_struct(cache_shape, cfg.dtype),
-                            self._rep_struct((slots,), jnp.int32),
-                            self._rep_struct((T, L), jnp.int32),
-                            self._rep_struct((T, L), jnp.bool_),
-                            self._rep_struct((T,), jnp.int32),
-                            self._rep_struct((T,), jnp.int32),
-                            self._rep_struct((T,), jnp.float32),
-                            self._rep_struct((T,), jnp.int32),
-                        )
-                        .compile()
+                    self._prefill_compiled[T, L] = self._compile_cell(
+                        f"lm/{self.layout}/prefill/t{T}/b{L}",
+                        lambda fn=fn, T=T, L=L: (
+                            jax.jit(fn, donate_argnums=(1, 2, 3))
+                            .lower(
+                                self.params,
+                                self._cache_struct(cache_shape, cfg.dtype),
+                                self._cache_struct(cache_shape, cfg.dtype),
+                                self._rep_struct((slots,), jnp.int32),
+                                self._rep_struct((T, L), jnp.int32),
+                                self._rep_struct((T, L), jnp.bool_),
+                                self._rep_struct((T,), jnp.int32),
+                                self._rep_struct((T,), jnp.int32),
+                                self._rep_struct((T,), jnp.float32),
+                                self._rep_struct((T,), jnp.int32),
+                            )
+                            .compile()
+                        ),
                     )
         else:
+            self._plan_cells(
+                len(self.batch_tiers) * len(self._chunk_buckets) + 1
+                + (1 if self.prefix_cache is not None else 0)
+            )
             chunk_fn = self._wrap_chunk(
                 _make_causal_chunk_prefill(self.model, self.cache_len)
             )
             pool_struct = self._cache_struct(pool_shape, cfg.dtype)
             for T in self.batch_tiers:
                 for C in self._chunk_buckets:
-                    self._chunk_compiled[T, C] = (
-                        jax.jit(chunk_fn, donate_argnums=(1, 2, 3))
-                        .lower(
-                            self.params,
-                            self._cache_struct(cache_shape, cfg.dtype),
-                            self._cache_struct(cache_shape, cfg.dtype),
-                            self._rep_struct((slots,), jnp.int32),
-                            pool_struct,
-                            pool_struct,
-                            self._rep_struct((T, C), jnp.int32),
-                            self._rep_struct((T,), jnp.int32),
-                            self._rep_struct((T,), jnp.int32),
-                            self._rep_struct((T, self._max_chain),
-                                             jnp.int32),
-                            self._rep_struct((T,), jnp.int32),
-                            self._rep_struct((T,), jnp.int32),
-                            self._rep_struct((T,), jnp.float32),
-                            self._rep_struct((T,), jnp.int32),
-                        )
-                        .compile()
+                    self._chunk_compiled[T, C] = self._compile_cell(
+                        f"lm/{self.layout}/chunk/t{T}/c{C}",
+                        lambda T=T, C=C: (
+                            jax.jit(chunk_fn, donate_argnums=(1, 2, 3))
+                            .lower(
+                                self.params,
+                                self._cache_struct(cache_shape, cfg.dtype),
+                                self._cache_struct(cache_shape, cfg.dtype),
+                                self._rep_struct((slots,), jnp.int32),
+                                pool_struct,
+                                pool_struct,
+                                self._rep_struct((T, C), jnp.int32),
+                                self._rep_struct((T,), jnp.int32),
+                                self._rep_struct((T,), jnp.int32),
+                                self._rep_struct((T, self._max_chain),
+                                                 jnp.int32),
+                                self._rep_struct((T,), jnp.int32),
+                                self._rep_struct((T,), jnp.int32),
+                                self._rep_struct((T,), jnp.float32),
+                                self._rep_struct((T,), jnp.int32),
+                            )
+                            .compile()
+                        ),
                     )
             if self.prefix_cache is not None:
                 insert_fn = self._wrap_insert(
                     _make_prefix_insert(self.block_tokens)
                 )
-                self._insert_compiled = (
-                    jax.jit(insert_fn, donate_argnums=(0, 1, 2, 3))
-                    .lower(
-                        pool_struct,
-                        pool_struct,
-                        self._cache_struct(cache_shape, cfg.dtype),
-                        self._cache_struct(cache_shape, cfg.dtype),
-                        self._rep_struct((), jnp.int32),
-                        self._rep_struct((self._max_chain,), jnp.int32),
-                        self._rep_struct((self._max_chain,), jnp.int32),
-                    )
-                    .compile()
+                self._insert_compiled = self._compile_cell(
+                    f"lm/{self.layout}/insert",
+                    lambda: (
+                        jax.jit(insert_fn, donate_argnums=(0, 1, 2, 3))
+                        .lower(
+                            pool_struct,
+                            pool_struct,
+                            self._cache_struct(cache_shape, cfg.dtype),
+                            self._cache_struct(cache_shape, cfg.dtype),
+                            self._rep_struct((), jnp.int32),
+                            self._rep_struct((self._max_chain,), jnp.int32),
+                            self._rep_struct((self._max_chain,), jnp.int32),
+                        )
+                        .compile()
+                    ),
                 )
         decode_fn = self._wrap(
             _make_causal_decode(self.model, self.cache_len), n_batch=4
         )
-        self._decode_compiled = (
-            jax.jit(decode_fn, donate_argnums=(1, 2, 3))
-            .lower(
-                self.params,
-                self._cache_struct(cache_shape, cfg.dtype),
-                self._cache_struct(cache_shape, cfg.dtype),
-                self._rep_struct((slots,), jnp.int32),
-                self._rep_struct((slots,), jnp.int32),
-                self._rep_struct((slots,), jnp.bool_),
-                self._rep_struct((slots,), jnp.float32),
-                self._rep_struct((slots,), jnp.int32),
-            )
-            .compile()
+        self._decode_compiled = self._compile_cell(
+            f"lm/{self.layout}/decode",
+            lambda: (
+                jax.jit(decode_fn, donate_argnums=(1, 2, 3))
+                .lower(
+                    self.params,
+                    self._cache_struct(cache_shape, cfg.dtype),
+                    self._cache_struct(cache_shape, cfg.dtype),
+                    self._rep_struct((slots,), jnp.int32),
+                    self._rep_struct((slots,), jnp.int32),
+                    self._rep_struct((slots,), jnp.bool_),
+                    self._rep_struct((slots,), jnp.float32),
+                    self._rep_struct((slots,), jnp.int32),
+                )
+                .compile()
+            ),
         )
         logger.info(
             "causal-LM engine ready: layout=%s slots=%d cache_len=%d "
@@ -1378,22 +1511,28 @@ class ImageClassifierEngine(_AotEngine):
         max_batch: int = 8,
         batch_tiers: tuple[int, ...] | None = None,
         top_k: int = 5,
+        memory=None,
     ):
-        super().__init__(mesh, max_batch, batch_tiers)
+        super().__init__(mesh, max_batch, batch_tiers, memory=memory)
         self.model = model
         self.image_shape = tuple(image_shape)
         self.top_k = top_k
         self.variables = self._place(
             {"params": params, **(model_state or {})}
         )
+        self.memory.register_tree("image_params", self.variables)
+        self._plan_cells(len(self.batch_tiers))
         self._compiled = {
-            T: (
-                jax.jit(self._forward)
-                .lower(
-                    self.variables,
-                    self._struct((T, *self.image_shape), jnp.float32, T),
-                )
-                .compile()
+            T: self._compile_cell(
+                f"image/{self.layout}/t{T}",
+                lambda T=T: (
+                    jax.jit(self._forward)
+                    .lower(
+                        self.variables,
+                        self._struct((T, *self.image_shape), jnp.float32, T),
+                    )
+                    .compile()
+                ),
             )
             for T in self.batch_tiers
         }

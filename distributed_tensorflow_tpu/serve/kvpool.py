@@ -44,6 +44,8 @@ from __future__ import annotations
 
 import threading
 
+from distributed_tensorflow_tpu.obs.flightrec import NULL_RECORDER
+
 __all__ = ["KVBlockPool", "PrefixMatch"]
 
 
@@ -103,6 +105,10 @@ class KVBlockPool:
         self._by_block: dict[int, _TrieNode] = {}
         self._ticks = 0
         self._evictions = 0
+        # Flight-recorder sink for prefix_evict events; the continuous
+        # batcher swaps in its recorder when one is enabled. Recording is
+        # a leaf-lock append (pool _lock -> recorder lock, never out).
+        self.recorder = NULL_RECORDER
 
     # ------------------------------------------------------------- lookup
 
@@ -185,6 +191,8 @@ class KVBlockPool:
         del victim.parent.children[victim.key]
         del self._by_block[victim.block]
         self._evictions += 1
+        self.recorder.record("prefix_evict", block=victim.block,
+                             tick=victim.tick)
         return victim.block
 
     # -------------------------------------------------------------- stats

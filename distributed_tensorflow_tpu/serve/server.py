@@ -22,6 +22,15 @@ Routes::
                         recent-span summary
     GET  /tracez?spans=N -> drain the span ring buffer as Chrome
                         trace-event JSON (Perfetto / chrome://tracing)
+    GET  /memz       -> device-memory accounting: per-component HBM
+                        reservations, per-device memory_stats() where the
+                        backend reports them, headroom + reconciliation
+    GET  /compilez   -> AOT-grid compile digest: cells total/compiled/
+                        failed, cumulative compile seconds, per-cell
+                        records, the coldest cell
+    POST /debugz/dump-> force a flight-recorder dump (bypasses the rate
+                        limit); answers the dump path, or the full payload
+                        when no --dump-dir is configured
     POST /profilez?ms=N -> capture a bounded jax.profiler window on the
                         RUNNING server (needs trace_dir)
     POST /drainz     -> flip to draining: /healthz goes 503 so the router
@@ -60,7 +69,9 @@ from distributed_tensorflow_tpu.obs.export import (
     PROM_CONTENT_TYPE,
     prometheus_text,
 )
+from distributed_tensorflow_tpu.obs.flightrec import NULL_RECORDER
 from distributed_tensorflow_tpu.obs.health import HealthTracker
+from distributed_tensorflow_tpu.obs.memory import default_registry
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
 from distributed_tensorflow_tpu.obs.slo import SloSpec, SloTracker
 from distributed_tensorflow_tpu.obs.timeseries import bounds_with
@@ -94,8 +105,20 @@ class Client:
         tracer: Tracer | None = None,
         slo: SloSpec | None = None,
         admission: str = "continuous",
+        recorder=None,
+        memory=None,
+        warmup_ready_fraction: float = 1.0,
     ):
         self.engine = engine
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        # The memory registry /memz answers from: an injected one, the
+        # engine's (real engines register their footprints with the
+        # process-wide default), or the default for bare stubs.
+        self.memory = (
+            memory
+            if memory is not None
+            else getattr(engine, "memory", None) or default_registry()
+        )
         if metrics is None:
             # Insert the SLO latency threshold as an explicit histogram
             # bound so windowed attainment at the threshold is EXACT.
@@ -127,6 +150,7 @@ class Client:
                 metrics=self.metrics,
                 admission=admission,
                 tracer=self.tracer,
+                recorder=self.recorder,
                 layout=getattr(engine, "layout", ""),
             )
         else:
@@ -147,17 +171,55 @@ class Client:
                 fetch=getattr(engine, "fetch", None),
                 bucket_for=bucket_for,
                 tracer=self.tracer,
+                recorder=self.recorder,
                 layout=getattr(engine, "layout", ""),
             )
         # SLO + readiness: the tracker reads the windowed families and the
         # batcher's live status at probe time — no thread, nothing to join.
-        self.slo = SloTracker(self.metrics, slo or SloSpec())
+        self.slo = SloTracker(
+            self.metrics, slo or SloSpec(), recorder=self.recorder
+        )
+        gs = getattr(engine, "grid_status", None)
+        self._grid_status = gs if callable(gs) else None
         self.health = HealthTracker(
             status_fn=self.batcher.status,
             metrics=self.metrics if self.metrics.windowed else None,
             slo=self.slo if self.slo.spec.enabled else None,
+            warmup_fn=(
+                (lambda: self._grid_status()["warm_fraction"])
+                if self._grid_status is not None else None
+            ),
+            warmup_target=warmup_ready_fraction,
+            recorder=self.recorder,
         )
-        self.health.mark_ready()  # batcher threads are up; we can serve
+        if self._grid_status is None:
+            # No grid to warm (stub / legacy engine): serve immediately.
+            # Grid engines instead stay ``starting`` until a probe sees the
+            # warm fraction reach the target (docs/DEPLOY.md contract) —
+            # synchronous-compiling engines are warm by the time we get
+            # here, so their first probe promotes.
+            self.health.mark_ready()
+        self.recorder.attach(
+            metrics_fn=self.metrics.snapshot,
+            memz_fn=self.memory.snapshot,
+            compilez_fn=self.grid_status,
+            tracer_fn=self.tracer.summary,
+        )
+
+    def grid_status(self) -> dict:
+        """The engine's AOT-grid compile digest (an always-warm placeholder
+        for engines without one, so /compilez answers on every stack)."""
+        if self._grid_status is not None:
+            return self._grid_status()
+        return {
+            "cells_total": 0,
+            "cells_compiled": 0,
+            "cells_failed": 0,
+            "compile_seconds_total": 0.0,
+            "warm_fraction": 1.0,
+            "coldest_cell": None,
+            "cells": [],
+        }
 
     def submit(self, payload: dict, request_id: str | None = None) -> Future:
         try:
@@ -278,6 +340,14 @@ def build_http_server(
                 "phase_ms": snap["phase_ms"],
                 "tracer": tracer.status(),
                 "recent_spans": tracer.summary(),
+                # Warmup digest (per-cell records live on /compilez) + the
+                # flight recorder's ring/dump counters.
+                "grid": {
+                    k: v
+                    for k, v in client.grid_status().items()
+                    if k != "cells"
+                },
+                "flight_recorder": client.recorder.status(),
             }
 
         def do_GET(self):
@@ -299,6 +369,8 @@ def build_http_server(
                                 else None
                             ),
                             health=client.health,
+                            memory=client.memory,
+                            grid=client.grid_status(),
                         ),
                         PROM_CONTENT_TYPE,
                     )
@@ -311,6 +383,10 @@ def build_http_server(
                 )
             elif url.path == "/statusz":
                 self._reply(200, self._statusz())
+            elif url.path == "/memz":
+                self._reply(200, client.memory.snapshot())
+            elif url.path == "/compilez":
+                self._reply(200, client.grid_status())
             elif url.path == "/tracez":
                 q = parse_qs(url.query)
                 try:
@@ -354,6 +430,22 @@ def build_http_server(
                 code, body = client.health.probe()
                 self._reply(200, {"draining": True, **body})
                 return
+            if url.path == "/debugz/dump":
+                if not client.recorder.enabled:
+                    self._reply(
+                        503,
+                        {"error": "flight recorder disabled "
+                                  "(pass --flight-buffer > 0)"},
+                    )
+                    return
+                out = client.recorder.dump("manual", force=True)
+                if isinstance(out, dict):
+                    # No dump_dir configured: answer the payload inline so
+                    # an operator (or the round-trip test) still gets it.
+                    self._reply(200, out)
+                else:
+                    self._reply(200, {"reason": "manual", "path": str(out)})
+                return
             fields = self._routes.get(url.path)
             if fields is None:
                 self._reply(404, {"error": f"no route {url.path}"})
@@ -389,6 +481,10 @@ def build_http_server(
                     )
                 else:
                     logger.exception("request %s failed", rid)
+                    client.recorder.record(
+                        "server_error", rid, error=type(e).__name__,
+                    )
+                    client.recorder.trigger("server_error")
                     self._reply(500, {"error": str(e), "request_id": rid})
             else:
                 body = {k: result[k] for k in fields if k in result}

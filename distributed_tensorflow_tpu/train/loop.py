@@ -30,6 +30,7 @@ from typing import Any
 
 import jax
 
+from distributed_tensorflow_tpu.obs.memory import default_registry
 from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
 from distributed_tensorflow_tpu.obs.trace import NULL_TRACER, Tracer
 
@@ -55,6 +56,7 @@ def fit(
     feed_metrics: FeedMetrics | None = None,
     tracer: Tracer | None = None,
     timeline=None,
+    memory=None,
 ):
     """Run the training loop; returns the final state.
 
@@ -87,11 +89,28 @@ def fit(
     in-line straggler detector — the per-host health view the fleet
     beacons publish (cli/train.py ``--beacon-dir``). Three clock reads and
     a histogram insert per step; ``None`` (the default) costs nothing.
+
+    ``memory`` (obs/memory.py :class:`MemoryRegistry`; default the
+    process-wide registry) receives the ``params`` / ``opt_state`` /
+    ``grad_ring`` byte footprints once at loop entry — shape-derived, so
+    the accounting never touches the step stream.
     """
     if rng is None:
         rng = jax.random.key(0)
     if tracer is None:
         tracer = NULL_TRACER
+    # HBM accounting (obs/memory.py): shape-derived byte counts, no device
+    # sync. ``memory`` defaults to the process-wide registry so a train
+    # process's footprints show up anywhere /memz-style tooling looks.
+    if memory is None:
+        memory = default_registry()
+    for component, tree in (
+        ("params", getattr(state, "params", None)),
+        ("opt_state", getattr(state, "opt_state", None)),
+        ("grad_ring", getattr(state, "grad_buffer", None)),
+    ):
+        if tree is not None:
+            memory.register_tree(component, tree)
     it: Iterator = iter(data)
     if feed_metrics is None:
         feed_metrics = getattr(data, "metrics", None) or FeedMetrics()

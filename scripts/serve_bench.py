@@ -415,13 +415,14 @@ def make_prefix_payloads(n: int, *, heads: int, head_len: int,
     return out
 
 
-def _decode_parity_probe(n_requests: int) -> tuple[bool, float]:
+def _decode_parity_probe(n_requests: int) -> tuple[bool, float, dict]:
     """Numerics tripwire ahead of the sim A/B: a real (tiny) causal-LM
     engine decodes a mixed backlog through the continuous batcher — more
     requests than slots, so admissions join mid-flight — and every token
     stream must equal a cache-free full-forward greedy reference. Returns
-    ``(parity_ok, max_phase_divergence)`` with the divergence measured on
-    the REAL engine's phase spans (queue_wait/prefill/decode vs wall)."""
+    ``(parity_ok, max_phase_divergence, grid_status)`` with the divergence
+    measured on the REAL engine's phase spans (queue_wait/prefill/decode
+    vs wall) and the grid digest from the engine's AOT compiles."""
     import jax
     import jax.numpy as jnp
 
@@ -486,7 +487,7 @@ def _decode_parity_probe(n_requests: int) -> tuple[bool, float]:
                 max_div,
                 abs(sum(f.phases.values()) - f.latency_s) / f.latency_s,
             )
-    return ok, max_div
+    return ok, max_div, engine.grid_status()
 
 
 def _run_decode_point(args, admission: str, payloads: list[dict],
@@ -781,9 +782,10 @@ def run_decode(args) -> int:
 
     print("# decode parity probe: real tiny causal-LM engine, greedy, "
           "mid-flight admissions vs full-forward reference")
-    parity_ok, parity_div = _decode_parity_probe(3 if args.quick else 6)
+    parity_ok, parity_div, grid = _decode_parity_probe(3 if args.quick else 6)
     print(f"# parity {'ok' if parity_ok else 'FAIL'}, real-engine phase "
           f"divergence {100 * parity_div:.1f}%")
+    _print_grid_summary(grid)
 
     rows = {}
     for admission in ("continuous", "flush"):
@@ -902,6 +904,7 @@ def run_decode(args) -> int:
                 "open_rps": open_rps,
             },
             "parity_ok": parity_ok,
+            "grid": {k: v for k, v in grid.items() if k != "cells"},
             "ab": rows,
             "speedup_tokens_per_s": speedup,
             "ttft_p50_ratio": ttft_ratio,
@@ -959,6 +962,86 @@ def run_decode(args) -> int:
                   "first tokens", file=sys.stderr)
             return 1
     return 0
+
+
+def _run_recorder_ab(args) -> dict:
+    """Flight-recorder overhead A/B + forced-dump round-trip.
+
+    Two identical sim-engine backlog drains, recorder off vs on (the same
+    measurement shape as PR 10's windowed-metrics gate): the sim engine's
+    fixed per-step sleep dominates, so the recorder's per-event cost shows
+    up directly in tokens/s. Best-of-2 per arm irons out sleep jitter.
+    Afterwards the ON arm's recorder is force-dumped and the payload is
+    serialized through ``json`` and parsed back — the ISSUE acceptance
+    check that a dump round-trips with all four sidecar sections.
+    """
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.serve import BatcherConfig, Client
+
+    payloads = make_decode_payloads(
+        min(args.decode_requests, 48), max_new=args.max_new_tokens,
+        vocab=args.vocab,
+    )
+
+    def drain(recorder) -> float:
+        eng = SimStepEngine(
+            slots=args.slots, max_batch=args.max_batch,
+            max_new_tokens=args.max_new_tokens, step_ms=args.sim_step_ms,
+        )
+        client = Client(
+            eng,
+            BatcherConfig(
+                max_batch=args.max_batch, max_queue=args.max_queue,
+                max_in_flight=args.max_in_flight,
+                max_delay_ms=args.max_delay_ms,
+            ),
+            recorder=recorder,
+        )
+        try:
+            client.call(payloads[0], timeout=120)  # warm the threads
+            t0 = time.monotonic()
+            futs = [client.submit(dict(p)) for p in payloads]
+            toks = sum(f.result(timeout=600)["n_tokens"] for f in futs)
+            wall = time.monotonic() - t0
+        finally:
+            client.close()
+        return toks / wall
+
+    recorder = FlightRecorder(capacity=4096)  # no dump_dir: dump() returns
+    base_tps = max(drain(None) for _ in range(2))  # the payload inline
+    rec_tps = max(drain(recorder) for _ in range(2))
+    overhead = max(0.0, 1.0 - rec_tps / base_tps) if base_tps else 0.0
+
+    # Forced dump -> json round-trip. The Client attached all four sidecar
+    # sections in __init__; every key must come back as a real object.
+    parsed = json.loads(json.dumps(recorder.dump("bench", force=True),
+                                   default=str))
+    sections_ok = all(
+        isinstance(parsed.get(k), dict)
+        for k in ("metrics", "memz", "compilez", "tracer")
+    )
+    return {
+        "baseline_tokens_per_s": base_tps,
+        "recorder_tokens_per_s": rec_tps,
+        "overhead_frac": overhead,
+        "events_recorded": len(parsed.get("events", [])),
+        "dropped_events": parsed["recorder"]["dropped_events"],
+        "dump_sections_ok": sections_ok,
+    }
+
+
+def _print_grid_summary(grid: dict) -> None:
+    """The one-line AOT-grid digest (/compilez over the bench engine) so
+    PERF.md rounds can attribute warmup cost."""
+    cold = grid.get("coldest_cell")
+    cold_s = (
+        f", coldest {cold['key']} ({cold['seconds']:.2f}s)" if cold else ""
+    )
+    print(
+        f"# aot grid: {grid['cells_compiled']}/{grid['cells_total']} cells "
+        f"compiled ({grid['cells_failed']} failed) in "
+        f"{grid['compile_seconds_total']:.2f}s{cold_s}"
+    )
 
 
 def _parse_layout(name: str) -> dict | None:
@@ -1240,6 +1323,7 @@ def main(argv=None) -> int:
         return run_mesh_compare(args)
 
     client, vocab = build_client(args)
+    _print_grid_summary(client.grid_status())
     payloads = make_payloads(vocab, args.buckets)
     metrics = client.metrics
 
@@ -1396,6 +1480,20 @@ def main(argv=None) -> int:
             print(f"    {name} burn rate: {burns}")
     report["max_slo_attainment_gap"] = max_slo_gap
 
+    # ---------------------------------------------- flight recorder
+    # Overhead A/B (sim engine, recorder off vs on) + forced-dump JSON
+    # round-trip — the obs-quick gates for obs/flightrec.py.
+    rec = _run_recorder_ab(args)
+    report["flight_recorder"] = rec
+    print(
+        f"\nflight recorder: {rec['recorder_tokens_per_s']:.0f} tok/s on / "
+        f"{rec['baseline_tokens_per_s']:.0f} off "
+        f"({100 * rec['overhead_frac']:.2f}% overhead), "
+        f"{rec['events_recorded']} events buffered "
+        f"({rec['dropped_events']} dropped), dump sections "
+        f"{'ok' if rec['dump_sections_ok'] else 'MISSING'}"
+    )
+
     if args.trace_dir:
         trace_path = os.path.join(args.trace_dir, "serve_bench_trace.json")
         client.tracer.export(trace_path)
@@ -1419,6 +1517,22 @@ def main(argv=None) -> int:
             f"{max_slo_gap:.4f} (>0.02) from the exact per-request log — "
             "the SLO math has drifted (threshold no longer an exact bucket "
             "bound, or the windowed observe path lost samples)",
+            file=sys.stderr,
+        )
+        return 1
+    if not rec["dump_sections_ok"] or not rec["events_recorded"]:
+        print(
+            "FAIL: forced flight-recorder dump did not round-trip through "
+            "JSON with events + all four sidecar sections "
+            "(metrics/memz/compilez/tracer)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.quick and rec["overhead_frac"] > 0.02:
+        print(
+            f"FAIL: flight-recorder overhead "
+            f"{100 * rec['overhead_frac']:.2f}% (>2%) — recording is no "
+            "longer a cheap ring append on the hot path",
             file=sys.stderr,
         )
         return 1

@@ -1,0 +1,424 @@
+"""Memory accounting, AOT-grid/warmup readiness, and the flight recorder
+(obs/memory.py, obs/flightrec.py, serve/server.py /memz //compilez
+//debugz/dump): registry reconciliation + CPU degradation, ring-overflow
+drop counters, dump rate limiting/atomicity, warmup-gated 503s, and the
+injected-engine-failure dump round-trip."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.obs.flightrec import (
+    NULL_RECORDER,
+    FlightRecorder,
+)
+from distributed_tensorflow_tpu.obs.memory import (
+    MemoryRegistry,
+    default_registry,
+    reset_default_registry,
+    tree_nbytes,
+)
+from distributed_tensorflow_tpu.serve import BatcherConfig
+from distributed_tensorflow_tpu.serve.engine import RequestError
+from distributed_tensorflow_tpu.serve.server import Client, build_http_server
+
+from tests.test_serve_health import _get, _post, _serve  # shared HTTP idiom
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ------------------------------------------------------------ MemoryRegistry
+
+
+def test_registry_register_add_release_ledger():
+    reg = MemoryRegistry(devices_fn=list)
+    reg.register("params", 100)
+    reg.register("params", 80)  # SET semantics: re-register overwrites
+    reg.add("staging_buffers", 10)
+    reg.add("staging_buffers", 5)
+    assert reg.components() == {"params": 80, "staging_buffers": 15}
+    assert reg.accounted_bytes() == 95
+    assert reg.release("params") == 80
+    assert reg.release("params") == 0  # already gone
+    assert reg.release("missing") == 0
+    snap = reg.snapshot()
+    assert snap["components"] == {"staging_buffers": 15}
+    assert snap["released"] == {"params": 80}
+    assert snap["accounted_bytes"] == 15
+
+
+def test_registry_partial_release():
+    reg = MemoryRegistry(devices_fn=list)
+    reg.register("pool", 100)
+    assert reg.release("pool", 30) == 30
+    assert reg.components() == {"pool": 70}
+    assert reg.snapshot()["released"] == {"pool": 30}
+
+
+def test_tree_nbytes_and_register_tree():
+    tree = {"a": np.zeros((4, 8), np.float32), "b": [np.zeros(3, np.int64)]}
+    assert tree_nbytes(tree) == 4 * 8 * 4 + 3 * 8
+    reg = MemoryRegistry(devices_fn=list)
+    assert reg.register_tree("params", tree) == reg.accounted_bytes()
+
+
+class _StubDevice:
+    platform = "tpu"
+
+    def __init__(self, in_use, limit):
+        self._stats = {"bytes_in_use": in_use, "bytes_limit": limit}
+
+    def memory_stats(self):
+        return self._stats
+
+
+class _BrokenDevice:
+    platform = "cpu"
+
+    def memory_stats(self):
+        raise RuntimeError("memory_stats unsupported")
+
+
+def test_registry_reconciles_against_stub_devices():
+    reg = MemoryRegistry(
+        devices_fn=lambda: [_StubDevice(1000, 4000), _StubDevice(1000, 4000)]
+    )
+    reg.register("params", 2000)
+    rec = reg.reconcile()
+    assert rec["devices_reporting"] == rec["devices_total"] == 2
+    assert rec["reported_bytes_in_use"] == 2000
+    assert rec["headroom_bytes"] == 6000
+    # The ISSUE 10% check, where the backend reports: accounted == in_use.
+    assert abs(rec["ratio"] - 1.0) < 0.1
+
+
+def test_registry_degrades_per_device():
+    """One broken device must not hide the reporting one — and a backend
+    with NO reporting devices (CPU) degrades to accounted-only."""
+    reg = MemoryRegistry(
+        devices_fn=lambda: [_StubDevice(500, 1000), _BrokenDevice()]
+    )
+    reg.register("params", 500)
+    rec = reg.reconcile()
+    assert rec["devices_total"] == 2 and rec["devices_reporting"] == 1
+    assert rec["reported_bytes_in_use"] == 500
+
+    cpu = MemoryRegistry(devices_fn=lambda: [_BrokenDevice()])
+    cpu.register("params", 500)
+    rec = cpu.reconcile()
+    assert rec["devices_reporting"] == 0
+    assert rec["reported_bytes_in_use"] is None
+    assert rec["headroom_bytes"] is None and rec["ratio"] is None
+    assert rec["accounted_bytes"] == 500  # the answer that always works
+
+
+def test_registry_no_backend_at_all():
+    def boom():
+        raise RuntimeError("no runtime")
+
+    reg = MemoryRegistry(devices_fn=boom)
+    assert reg.device_stats() == []
+    assert reg.snapshot()["devices_total"] == 0
+
+
+def test_default_registry_reset():
+    reset_default_registry()
+    default_registry().register("x", 1)
+    assert default_registry().components() == {"x": 1}
+    reset_default_registry()
+    assert default_registry().components() == {}
+
+
+# ------------------------------------------------------------ FlightRecorder
+
+
+def test_ring_overflow_counts_drops_oldest_first():
+    rec = FlightRecorder(capacity=4, clock=FakeClock())
+    for i in range(6):
+        rec.record("request_admit", request_id=f"r{i}")
+    st = rec.status()
+    assert st["buffered_events"] == 4
+    assert st["dropped_events"] == 2
+    assert [e["request_id"] for e in rec.events()] == ["r2", "r3", "r4", "r5"]
+
+
+def test_disabled_recorder_is_noop():
+    assert NULL_RECORDER.enabled is False
+    NULL_RECORDER.record("request_admit", request_id="x")
+    assert NULL_RECORDER.events() == []
+    assert NULL_RECORDER.dump("manual", force=True) is None
+    assert NULL_RECORDER.trigger("slo_page") is None
+
+
+def test_dump_rate_limited_and_force_bypasses():
+    clk = FakeClock()
+    rec = FlightRecorder(capacity=8, min_dump_interval_s=30.0, clock=clk)
+    assert rec.dump("slo_page") is not None  # first dump always allowed
+    assert rec.trigger("slo_page") is None  # inside the window: suppressed
+    assert rec.dump("manual", force=True) is not None  # manual bypass
+    clk.t += 31.0
+    assert rec.trigger("slo_page") is not None  # window elapsed
+    st = rec.status()
+    assert st["dumps_written"] == 3
+    assert st["dumps_suppressed"] == 1
+
+
+def test_dump_writes_valid_json_file(tmp_path):
+    rec = FlightRecorder(capacity=8, dump_dir=tmp_path)
+    rec.attach(
+        metrics_fn=lambda: {"requests": 1},
+        memz_fn=lambda: {"components": {}},
+        compilez_fn=lambda: {"warm_fraction": 1.0},
+        tracer_fn=lambda: {"spans": 0},
+    )
+    rec.record("health_transition", state="ready")
+    path = rec.dump("manual", force=True)
+    assert path is not None and path.exists()
+    assert not list(tmp_path.glob("*.tmp"))  # atomic: no torn leftovers
+    payload = json.loads(path.read_text())
+    for key in ("events", "metrics", "memz", "compilez", "tracer"):
+        assert payload[key] is not None, key
+    assert payload["reason"] == "manual"
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "health_transition" in kinds and "dump" in kinds
+
+
+def test_dump_sidecar_errors_do_not_lose_the_dump():
+    def broken():
+        raise ValueError("sidecar broke")
+
+    rec = FlightRecorder(capacity=4, clock=FakeClock())
+    rec.attach(metrics_fn=broken)
+    payload = rec.dump("manual", force=True)
+    assert "ValueError" in payload["metrics"]["error"]
+    assert payload["memz"] is None  # unattached sections stay None
+
+
+# -------------------------------------------------------- serving integration
+
+
+class _StubEngine:
+    max_batch = 4
+
+    def validate(self, payload):
+        if "input_ids" not in payload:
+            raise RequestError("input_ids required")
+
+    def run_batch(self, payloads):
+        return [
+            {"pred_ids": np.asarray(p["input_ids"], np.int32), "score": -1.5}
+            for p in payloads
+        ]
+
+
+class _FailingEngine(_StubEngine):
+    def run_batch(self, payloads):
+        raise RuntimeError("injected engine failure")
+
+
+class _WarmupEngine(_StubEngine):
+    """Stub with a grid: real engines compile synchronously (always warm by
+    construction), so partial warmth is exercised through this stand-in."""
+
+    def __init__(self):
+        self.warm = 0.5
+
+    def grid_status(self):
+        return {
+            "cells_total": 4,
+            "cells_compiled": int(4 * self.warm),
+            "cells_failed": 0,
+            "compile_seconds_total": 1.25,
+            "warm_fraction": self.warm,
+            "coldest_cell": {"key": "bert/single/t32/b4", "seconds": 0.75},
+            "cells": [],
+        }
+
+
+@pytest.fixture()
+def obs_server():
+    memory = MemoryRegistry(devices_fn=list)
+    memory.register("bert_params", 1024)
+    client = Client(
+        _StubEngine(),
+        BatcherConfig(max_batch=4, max_delay_ms=2.0),
+        recorder=FlightRecorder(capacity=64),
+        memory=memory,
+    )
+    server, thread, base = _serve(client)
+    yield base, client
+    server.shutdown()
+    server.server_close()
+    client.close()
+    thread.join(timeout=5)
+
+
+def test_memz_endpoint(obs_server):
+    base, client = obs_server
+    code, body, ctype = _get(base + "/memz")
+    assert code == 200 and ctype == "application/json"
+    assert body["components"] == {"bert_params": 1024}
+    assert body["accounted_bytes"] == 1024
+    # stub devices_fn=list -> no devices: the clean-degradation shape
+    assert body["devices_total"] == 0 and body["ratio"] is None
+
+
+def test_compilez_endpoint_always_warm_placeholder(obs_server):
+    base, _ = obs_server
+    code, body, _ = _get(base + "/compilez")
+    assert code == 200
+    assert body["warm_fraction"] == 1.0  # no grid: nothing to wait for
+    assert body["cells_total"] == 0 and body["coldest_cell"] is None
+
+
+def test_statusz_carries_grid_and_recorder_and_kv(obs_server):
+    base, client = obs_server
+    client.call({"input_ids": [1, 2]}, timeout=10)
+    code, body, _ = _get(base + "/statusz")
+    assert code == 200
+    assert body["grid"]["warm_fraction"] == 1.0
+    assert "cells" not in body["grid"]  # digest only, not the full roster
+    assert body["flight_recorder"]["enabled"] is True
+    assert body["flight_recorder"]["buffered_events"] > 0
+
+
+def test_debugz_dump_roundtrip_over_http(obs_server):
+    base, client = obs_server
+    client.call({"input_ids": [1, 2, 3]}, timeout=10)
+    code, body = _post(base + "/debugz/dump")
+    assert code == 200
+    assert body["reason"] == "manual"
+    for key in ("events", "metrics", "memz", "compilez", "tracer"):
+        assert isinstance(body[key], (dict, list)), key
+    kinds = {e["kind"] for e in body["events"]}
+    assert {"request_admit", "request_complete"} <= kinds
+    assert body["memz"]["components"] == {"bert_params": 1024}
+
+
+def test_debugz_dump_503_when_disabled():
+    client = Client(_StubEngine(), BatcherConfig(max_batch=4))
+    server, thread, base = _serve(client)
+    try:
+        with pytest.raises(Exception) as exc:
+            _post(base + "/debugz/dump")
+        assert "503" in str(exc.value)
+    finally:
+        server.shutdown()
+        server.server_close()
+        client.close()
+        thread.join(timeout=5)
+
+
+def test_healthz_warmup_gated_until_grid_compiles():
+    engine = _WarmupEngine()
+    client = Client(
+        engine,
+        BatcherConfig(max_batch=4),
+        warmup_ready_fraction=1.0,
+    )
+    server, thread, base = _serve(client)
+    try:
+        code, body, _ = _get(base + "/healthz")
+        assert code == 503
+        assert body["status"] == "starting"
+        assert body["warm_fraction"] == 0.5
+        assert "warming" in body["reason"]
+        engine.warm = 1.0  # grid finishes compiling
+        code, body, _ = _get(base + "/healthz")
+        assert code == 200 and body["status"] == "ready"
+        # The promotion is sticky — a later cold report can't un-ready.
+        engine.warm = 0.5
+        assert _get(base + "/healthz")[0] == 200
+    finally:
+        server.shutdown()
+        server.server_close()
+        client.close()
+        thread.join(timeout=5)
+
+
+def test_warmup_fraction_below_target_admits_early():
+    engine = _WarmupEngine()  # 50% warm
+    client = Client(
+        engine,
+        BatcherConfig(max_batch=4),
+        warmup_ready_fraction=0.5,
+    )
+    try:
+        state, detail = client.health.state()
+        assert state == "ready"
+        assert detail.get("warm_fraction") == 0.5
+    finally:
+        client.close()
+
+
+def test_injected_engine_failure_dumps(tmp_path):
+    """The ISSUE acceptance path: an engine dispatch failure must trigger a
+    dump file with all four sections AND the failure events in the ring."""
+    recorder = FlightRecorder(capacity=64, dump_dir=tmp_path)
+    client = Client(
+        _FailingEngine(),
+        BatcherConfig(max_batch=4, max_delay_ms=1.0),
+        recorder=recorder,
+    )
+    try:
+        fut = client.submit({"input_ids": [1, 2]})
+        with pytest.raises(Exception, match="injected engine failure"):
+            fut.result(timeout=10)
+        deadline = threading.Event()
+        for _ in range(50):  # the flusher thread writes the dump async
+            if list(tmp_path.glob("flightrec-*.json")):
+                break
+            deadline.wait(0.05)
+        dumps = list(tmp_path.glob("flightrec-*engine_failure.json"))
+        assert dumps, list(tmp_path.iterdir())
+        payload = json.loads(dumps[0].read_text())
+        for key in ("events", "metrics", "memz", "compilez", "tracer"):
+            assert payload[key] is not None, key
+        kinds = [e["kind"] for e in payload["events"]]
+        assert "engine_failure" in kinds
+    finally:
+        client.close()
+
+
+def test_prometheus_exports_hbm_and_grid_families():
+    from tests.test_serve_health import _parse_prom
+
+    from distributed_tensorflow_tpu.obs.export import prometheus_text
+    from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+
+    reg = MemoryRegistry(devices_fn=lambda: [_StubDevice(1000, 4000)])
+    reg.register("bert_params", 800)
+    reg.release("opt_state", None)
+    reg.register("opt_state", 200)
+    reg.release("opt_state")
+    grid = {
+        "cells_total": 4, "cells_compiled": 3, "cells_failed": 1,
+        "compile_seconds_total": 2.5, "warm_fraction": 0.75,
+        "coldest_cell": None, "cells": [],
+    }
+    text = prometheus_text(ServeMetrics(windowed=False), memory=reg,
+                           grid=grid)
+    samples, types = _parse_prom(text)
+    assert samples[
+        ("hbm_reserved_bytes", (("component", "bert_params"),))
+    ] == 800
+    assert samples[
+        ("hbm_released_bytes_total", (("component", "opt_state"),))
+    ] == 200
+    assert samples[
+        ("hbm_device_bytes_in_use", (("device", "0"), ("platform", "tpu")))
+    ] == 1000
+    assert samples[("serve_compile_cells", (("state", "compiled"),))] == 3
+    assert samples[("serve_compile_cells", (("state", "failed"),))] == 1
+    assert samples[("serve_compile_cells", (("state", "pending"),))] == 0
+    assert samples[("serve_compile_seconds_total", ())] == 2.5
+    assert samples[("serve_grid_warm_fraction", ())] == 0.75
+    assert types["serve_compile_seconds_total"] == "counter"
