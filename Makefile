@@ -24,8 +24,11 @@ obs-quick:
 
 # Continuous-batching decode gate (sub-30s): real-engine greedy parity vs
 # the full-forward reference, closed-form stream routing through the slot
-# table, phase-sum <=25%, and the flush-vs-continuous A/B (continuous
-# >=1.5x tokens/s with TTFT p50 no worse; docs/PERF.md round 11).
+# table, phase-sum <=25%, the flush-vs-continuous A/B (continuous
+# >=1.5x tokens/s with TTFT p50 no worse; docs/PERF.md round 11), and the
+# speculative-decoding A/B (spec-on streams bit-identical to spec-off on
+# both workloads, spec-on tokens/s >=0.9x spec-off on adversarial-random;
+# docs/PERF.md round 14).
 decode-quick:
 	$(PY) scripts/serve_bench.py --decode --quick
 

@@ -66,6 +66,18 @@ PREFIX_CACHE_VARIANTS: tuple[tuple[float, int], ...] = (
     (8.0, 32),
 )
 
+# Speculative-decoding configurations crossed into every decode cell that
+# serves: (spec_tokens, min_match, max_new_tokens). The 0 row is the
+# spec-off plan (must stay a no-op, never a reject); the oversized rows
+# must reject with a clean ValueError at plan time (a draft wider than the
+# generation budget or the position table would be a runtime shape error).
+SPEC_VARIANTS: tuple[tuple[int, int, int], ...] = (
+    (0, 2, 32),
+    (4, 2, 32),
+    (8, 3, 32),
+    (32, 2, 32),   # spec_tokens == max_new_tokens: must reject
+)
+
 # Mesh layouts exercised by tests/test_serve_mesh.py plus the CLI default
 # and the documented fallback probes, as (tp, pp, ep) on 8 devices.
 DEFAULT_LAYOUTS: tuple[tuple[int, int, int], ...] = (
@@ -305,6 +317,54 @@ def run_config_sweep(
                             )
                             plans.append({
                                 "mb": mb, "block_tokens": bt,
+                                "raised": type(exc).__name__,
+                            })
+                    # Same contract for the speculative-decoding plan
+                    # (serve/spec.py + the verify grid cell): each variant
+                    # plans a draft width or rejects with a clean
+                    # ValueError at startup, never a runtime shape error.
+                    cell["speculation"] = splans = []
+                    for sk, mm, mnt in SPEC_VARIANTS:
+                        try:
+                            k = engine_cls._plan_spec(
+                                cfg, tp=tp, spec_tokens=sk,
+                                min_match=mm, max_new_tokens=mnt,
+                            )
+                            splans.append({
+                                "spec_tokens": sk, "min_match": mm,
+                                "max_new_tokens": mnt, "k": k,
+                            })
+                        except ValueError as exc:
+                            splans.append({
+                                "spec_tokens": sk, "min_match": mm,
+                                "max_new_tokens": mnt,
+                                "rejects": str(exc),
+                            })
+                        except Exception as exc:
+                            findings.append(
+                                Finding(
+                                    check="SC002",
+                                    path=(
+                                        "distributed_tensorflow_tpu/"
+                                        "serve/engine.py"
+                                    ),
+                                    line=0,
+                                    scope=(
+                                        f"{engine_cls.__name__}"
+                                        "._plan_spec"
+                                    ),
+                                    message=(
+                                        f"speculation plan k={sk} "
+                                        f"min_match={mm} on preset "
+                                        f"'{name}' layout tp={tp} raised "
+                                        f"{type(exc).__name__} instead of "
+                                        f"a clean ValueError: {exc}"
+                                    ),
+                                )
+                            )
+                            splans.append({
+                                "spec_tokens": sk, "min_match": mm,
+                                "max_new_tokens": mnt,
                                 "raised": type(exc).__name__,
                             })
             except ValueError as exc:

@@ -171,6 +171,9 @@ def build_serving_client(cfg, args):
             prefix_cache_mb=args.prefix_cache_mb,
             block_tokens=args.block_tokens,
             prefill_chunk=args.prefill_chunk,
+            spec_tokens=args.spec_tokens,
+            spec_min_match=args.spec_min_match,
+            spec_backoff=args.spec_backoff,
         )
         vocab = pieces["model"].cfg.vocab_size
 
@@ -301,6 +304,20 @@ def main(argv: list[str] | None = None):
                         "long-prompt admission bounds in-flight requests' "
                         "inter-token latency (0 = monolithic prefill "
                         "unless --prefix-cache-mb is set)")
+    parser.add_argument("--spec-tokens", type=int, default=0,
+                        help="speculative-decoding draft length k: verify "
+                        "up to k n-gram-drafted tokens per slot in one "
+                        "[slots, k+1] forward, emitting the accepted run "
+                        "as multiple tokens per step (0 disables; output "
+                        "is bit-identical either way — see DEPLOY.md "
+                        "\"Speculative decoding\")")
+    parser.add_argument("--spec-min-match", type=int, default=2,
+                        help="shortest history n-gram the drafter may "
+                        "match; longer = fewer but better drafts")
+    parser.add_argument("--spec-backoff", type=float, default=0.25,
+                        help="per-slot acceptance-EMA threshold below "
+                        "which speculation backs off to plain decode "
+                        "(re-probing periodically)")
     parser.add_argument("--flush-admission", action="store_true",
                         help="admit new requests only when the slot table "
                         "is EMPTY (static batching; the A/B baseline for "

@@ -34,6 +34,13 @@ Three forwards share one param tree:
   fixed-size chunked prefill of long prompts; padding lanes carry the
   out-of-range sentinel position ``Lc`` so their cache writes drop
   (``mode="drop"``) while attention/embedding use the clamped position.
+- ``verify_step(tokens [S, K+1], positions [S, K+1], k_cache, v_cache) ->
+  (logits [S, K+1, V], k_cache', v_cache')`` — speculative decoding's
+  batched verify: score a slot's last verified token plus up to K draft
+  tokens in ONE dispatch. Same math as ``prefill_chunk`` (it delegates),
+  which is the point: column j's logits are bit-identical to what
+  ``decode_step`` would produce after j accepted tokens, so greedy
+  accept-matching preserves the exact non-speculative stream.
 
 Numerics: both attention paths accumulate scores and context in f32 with
 the same masking convention (fully-masked rows -> exactly 0), so a token
@@ -362,6 +369,19 @@ class CausalLM(nn.Module):
             new_k.append(kc)
             new_v.append(vc)
         return self._head(x), jnp.stack(new_k), jnp.stack(new_v)
+
+    def verify_step(self, tokens, positions, k_cache, v_cache):
+        # Speculative-decoding verify over the slot table: [S, K+1] tokens
+        # at absolute positions against per-slot caches. Column 0 is each
+        # slot's last verified token re-scored at its current position;
+        # columns 1..d are drafts; dead columns carry the sentinel position
+        # Lc so their writes drop. This IS prefill_chunk's contract with
+        # C = K+1 — delegating (rather than re-deriving the masking) keeps
+        # the `valid = pos <= position` and `mode="drop"` invariants in one
+        # place. K/V written for columns past the accepted prefix sit
+        # beyond the rolled-back slot position: masked dead, overwritten by
+        # the slot's next real tokens — rollback costs nothing.
+        return self.prefill_chunk(tokens, positions, k_cache, v_cache)
 
 
 def sample_tokens(logits, temperature, seed, step):

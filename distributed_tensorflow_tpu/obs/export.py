@@ -171,6 +171,22 @@ def serve_families(
         Family("serve_kv_pool_bytes", "gauge",
                "KV bytes held by the prefix-cache block pool")
         .add(m.kv_pool_bytes.value),
+        # Speculative-decoding families (serve/spec.py).
+        Family("serve_spec_draft_tokens_total", "counter",
+               "speculative draft tokens proposed")
+        .add(m.draft_tokens.value),
+        Family("serve_spec_accepted_tokens_total", "counter",
+               "speculative draft tokens accepted by verify")
+        .add(m.accepted_tokens.value),
+        Family("serve_spec_rejects_total", "counter",
+               "verify steps that rejected at least one draft")
+        .add(m.spec_rejects.value),
+        Family("serve_spec_acceptance_ratio", "gauge",
+               "lifetime draft-acceptance ratio (accepted/drafted)")
+        .add(
+            m.accepted_tokens.value / m.draft_tokens.value
+            if m.draft_tokens.value else 0.0
+        ),
     ]
 
     by_cause = Family("serve_rejected_by_cause_total", "counter",
@@ -273,7 +289,8 @@ def serve_families(
             for series, c in (
                 ("requests", m.requests_w), ("ok", m.ok_w),
                 ("rejected", m.rejected_w), ("failed", m.bad_w),
-                ("tokens", m.tokens_w),
+                ("tokens", m.tokens_w), ("spec_drafted", m.drafted_w),
+                ("spec_accepted", m.accepted_w),
             ):
                 rates.add(c.rate(w), {"window": wl, "series": series})
             summ = m.latency_w.window_summary(w)

@@ -52,6 +52,7 @@ EVENT_KINDS = (
     "slot_free",
     "prefix_hit",
     "prefix_evict",
+    "spec_backoff",     # speculation backoff engaged/disengaged for a slot
     "health_transition",
     "slo_verdict",
     "engine_failure",

@@ -357,6 +357,13 @@ class ServeMetrics:
         self.prefix_hits = Counter()
         self.prefix_tokens_saved = Counter()
         self.kv_pool_bytes = Gauge()
+        # Speculative-decoding (serve/spec.py) families: drafted candidate
+        # tokens, the subset the verify step accepted, and verify steps
+        # that rejected at least one draft. acceptance = accepted/drafted;
+        # the windowed twins below carry the trailing-rate form.
+        self.draft_tokens = Counter()
+        self.accepted_tokens = Counter()
+        self.spec_rejects = Counter()
         # ------------------------------------------------ windowed families
         # (obs/timeseries.py) — the SLO/health layer's inputs.  bad_w
         # counts requests that burned availability budget (backpressure +
@@ -371,6 +378,8 @@ class ServeMetrics:
         self.bad_w = WindowedCounter()        # budget-burning failures
         self.rejected_w = WindowedCounter()   # backpressure sheds only
         self.tokens_w = WindowedCounter()     # generated tokens (tokens/s)
+        self.drafted_w = WindowedCounter()    # speculative drafts proposed
+        self.accepted_w = WindowedCounter()   # speculative drafts accepted
 
     def observe_phase(self, name: str, seconds: float, layout: str = "") -> None:
         """Record one per-request phase sample, double-keyed by the engine's
@@ -405,12 +414,18 @@ class ServeMetrics:
         out = {}
         for w in self.WINDOWS_S:
             lat = self.latency_w.window_summary(w)
+            drafted = self.drafted_w.sum(w)
             out[f"{w:g}s"] = {
                 "request_rate": self.requests_w.rate(w),
                 "ok_rate": self.ok_w.rate(w),
                 "rejected_rate": self.rejected_w.rate(w),
                 "failure_rate": self.bad_w.rate(w),
                 "token_rate": self.tokens_w.rate(w),
+                # Trailing draft-acceptance rate (accepted/drafted over the
+                # window); 0.0 when speculation is off or idle.
+                "spec_acceptance": (
+                    self.accepted_w.sum(w) / drafted if drafted else 0.0
+                ),
                 "latency_ms": {
                     "count": lat["count"],
                     "p50": lat["p50"] * 1e3,
@@ -447,6 +462,9 @@ class ServeMetrics:
             "prefix_hits": self.prefix_hits.value,
             "prefix_tokens_saved": self.prefix_tokens_saved.value,
             "kv_pool_bytes": self.kv_pool_bytes.value,
+            "draft_tokens": self.draft_tokens.value,
+            "accepted_tokens": self.accepted_tokens.value,
+            "spec_rejects": self.spec_rejects.value,
             "ttft_ms": {
                 k: (v * 1e3 if k != "count" else v)
                 for k, v in self.ttft.summary().items()

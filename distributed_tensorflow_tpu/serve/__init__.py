@@ -16,6 +16,10 @@ training rather than a separate stack:
   trie over prompt-token blocks mapping shared heads to refcounted,
   LRU-evicted chains of device KV pages (the engine owns the pages, this
   owns what they mean).
+- ``spec.py``    — speculative decoding for the decode path: host-side
+  n-gram drafting over each slot's own history, exact-match acceptance
+  against one batched verify forward, and per-slot adaptive backoff
+  (output stays bit-identical to plain decode).
 - ``server.py``  — in-process :class:`Client` plus a stdlib-HTTP front end
   with latency/queue/occupancy metrics (obs/metrics.py ServeMetrics).
 
@@ -39,5 +43,10 @@ from distributed_tensorflow_tpu.serve.engine import (  # noqa: F401
 from distributed_tensorflow_tpu.serve.kvpool import (  # noqa: F401
     KVBlockPool,
     PrefixMatch,
+)
+from distributed_tensorflow_tpu.serve.spec import (  # noqa: F401
+    Drafter,
+    NGramDrafter,
+    SpecConfig,
 )
 from distributed_tensorflow_tpu.serve.server import Client, build_http_server  # noqa: F401

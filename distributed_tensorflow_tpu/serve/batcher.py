@@ -42,6 +42,7 @@ from concurrent.futures import Future
 from distributed_tensorflow_tpu.obs.flightrec import NULL_RECORDER
 from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
 from distributed_tensorflow_tpu.obs.trace import NULL_TRACER
+from distributed_tensorflow_tpu.serve.spec import SlotSpec
 
 logger = logging.getLogger(__name__)
 
@@ -510,7 +511,7 @@ class _Slot:
         "pending", "gen", "prompt_len", "length", "max_new", "eos_id",
         "temperature", "seed", "tokens", "n_dispatched", "t_first",
         "t_last_tok", "prefilling", "chunk_pos", "cached_len", "chain",
-        "slot_id",
+        "slot_id", "spec", "prompt_ids", "draft", "verifying",
     )
 
     def __init__(self, pending: _Pending, gen: int, payload: dict,
@@ -537,6 +538,16 @@ class _Slot:
         self.cached_len = 0
         self.chain = None
         self.slot_id = -1  # table index, stamped at admission (flight rec)
+        # Speculative-decoding bookkeeping (spec-enabled engines only):
+        # the per-occupancy SlotSpec state machine, the prompt as a plain
+        # int list (drafting history = prompt_ids + tokens), the draft
+        # awaiting its verify verdict, and whether a verify step is in
+        # flight — a verifying slot never re-dispatches until the verdict
+        # fetches (spec-mode slots advance at FETCH, not dispatch).
+        self.spec: SlotSpec | None = None
+        self.prompt_ids: list[int] = []
+        self.draft: list[int] | None = None
+        self.verifying = False
 
 
 class ContinuousBatcher:
@@ -583,12 +594,30 @@ class ContinuousBatcher:
     prefix pages back to the pool; chunk dispatches ride a batch-level
     ``prefill_chunk`` phase/span while per-request phases keep the same
     contiguous taxonomy (the ``prefill`` phase simply covers every chunk).
+
+    On a SPECULATIVE engine (``spec_tokens > 0``, exposing ``verify`` and
+    a ``spec`` config — serve/spec.py) each occupied slot carries a
+    :class:`~distributed_tensorflow_tpu.serve.spec.SlotSpec`: the loop
+    drafts from the slot's own prompt+generated history, dispatches ONE
+    fixed-shape ``[slots, k+1]`` verify step for every speculating slot,
+    and at fetch emits the accepted prefix plus the verified model token —
+    1..k+1 tokens per step, bit-identical to the plain stream (exact-match
+    acceptance against deterministic per-(seed, position) sampling).
+    Spec-mode slots advance ``length`` at FETCH and never overlap their
+    own steps (the verdict decides the next position); backed-off slots
+    (low acceptance EMA) ride the plain pipelined decode path unchanged,
+    re-probing periodically once their outstanding steps drain. The ITL
+    histogram stays PER TOKEN: a verify step that emits m+1 tokens
+    contributes m+1 samples splitting the step's wall interval.
     """
 
-    # Watched by obs.sanitizer.sanitize_races in tests/test_serve_decode.py;
-    # every access must be ordered by self._cv.
+    # Watched by obs.sanitizer.sanitize_races in tests/test_serve_decode.py
+    # and tests/test_serve_spec.py; every access must be ordered by
+    # self._cv.
     _RACETRACE_ATTRS = (
         "_queue", "_count", "_closed", "_slots", "_n_active", "_n_inflight",
+        "_steps", "_tokens_emitted", "_spec_drafted", "_spec_accepted",
+        "_spec_rejects",
     )
 
     def __init__(
@@ -631,6 +660,32 @@ class ContinuousBatcher:
             # Evictions happen inside the pool's allocator; hand it the
             # recorder so prefix_evict events land in the same ring.
             self._pool.recorder = self.recorder
+        # Speculative decoding: engines built with spec_tokens > 0 expose
+        # a verify dispatch + a SpecConfig (engine.spec); per-slot SlotSpec
+        # state is built at admission. Stubs and spec-off engines keep the
+        # plain decode path untouched.
+        self._spec_cfg = (
+            getattr(engine, "spec", None)
+            if callable(getattr(engine, "verify", None)) else None
+        )
+        self._spec_k = (
+            self._spec_cfg.spec_tokens if self._spec_cfg is not None else 0
+        )
+        # Draft-length cache-headroom guard; engines without a fixed
+        # cache_len (stubs) are unconstrained.
+        self._cache_len = getattr(engine, "cache_len", 1 << 30)
+        # tokens_per_step numerator/denominator for status(): emitted
+        # tokens over decode+verify step completions — the speculation
+        # win at a glance. Spec accounting totals live here too.
+        self._steps = 0
+        self._tokens_emitted = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_rejects = 0
+        # Backoff flips detected at PLAN time (empty-draft EMA decay);
+        # only the decode-loop thread touches this list (_take_work fills,
+        # _loop drains to the flight recorder outside _cv).
+        self._plan_events: list[tuple[str, int, str, float]] = []
         self._req_ids = itertools.count()
         self._gens = itertools.count(1)
         self._cv = threading.Condition()
@@ -718,6 +773,12 @@ class ContinuousBatcher:
                 "kv_active_bytes": self._n_active * getattr(
                     self._engine, "slot_page_bytes", 0
                 ),
+                # Emitted tokens per decode/verify step completion: 1.0 on
+                # a plain engine, >1 when speculation is winning.
+                "tokens_per_step": (
+                    self._tokens_emitted / self._steps
+                    if self._steps else 0.0
+                ),
             }
             if self._pool is not None:
                 # KV-pressure digest for /statusz + the fleet view: pool
@@ -739,6 +800,34 @@ class ContinuousBatcher:
                     ),
                     "tokens_saved": metrics.prefix_tokens_saved.value,
                 }
+            if self._spec_k:
+                # Speculation digest for /statusz: per-mode verify width,
+                # live acceptance EMA across occupants, lifetime totals.
+                digests = [
+                    s.spec.digest() for s in self._slots
+                    if s is not None and s.spec is not None
+                ]
+                backed = sum(1 for d in digests if d["backed_off"])
+                emas = [d["acceptance_ema"] for d in digests]
+                out["speculation"] = {
+                    "spec_tokens": self._spec_k,
+                    "min_match": self._spec_cfg.min_match,
+                    # Verify width by slot mode: full speculation drafts
+                    # k tokens, a backed-off slot runs plain decode (k=0).
+                    "mode_k": {"speculating": self._spec_k, "backed_off": 0},
+                    "slots_speculating": len(digests) - backed,
+                    "slots_backed_off": backed,
+                    "acceptance_ema": (
+                        sum(emas) / len(emas) if emas else 1.0
+                    ),
+                    "draft_tokens": self._spec_drafted,
+                    "accepted_tokens": self._spec_accepted,
+                    "rejects": self._spec_rejects,
+                    "acceptance_rate": (
+                        self._spec_accepted / self._spec_drafted
+                        if self._spec_drafted else 0.0
+                    ),
+                }
             return out
 
     # --------------------------------------------------------- decode loop
@@ -747,19 +836,21 @@ class ContinuousBatcher:
         """Include the slot in the next decode step? Occupied, fully
         prefilled, and not every requested token already dispatched (a
         slot whose last tokens are still in flight rides along inactive
-        until they fetch)."""
+        until they fetch). A slot with a verify step in flight is parked
+        until the verdict lands."""
         return (
             s is not None
             and not s.prefilling
+            and not s.verifying
             and s.n_dispatched < s.max_new
         )
 
     def _take_work(self):
         """Block until there is something to dispatch; returns
-        ``(admissions, chunk_rows, step)`` — any may be empty/None — or
-        None when closed and fully drained. All bookkeeping (slot
-        assignment, trie match, chunk/length advance) happens HERE under
-        ``_cv``; the caller just dispatches.
+        ``(admissions, chunk_rows, step, verify)`` — any may be empty/None
+        — or None when closed and fully drained. All bookkeeping (slot
+        assignment, trie match, chunk/length advance, draft assembly)
+        happens HERE under ``_cv``; the caller just dispatches.
 
         On a chunked engine an admission does NOT dispatch a prefill:
         the slot enters ``prefilling`` (its prompt possibly shortened by a
@@ -767,7 +858,17 @@ class ContinuousBatcher:
         ONE chunk batch — up to ``admit_cap`` rows, one ``chunk_size``
         slice each — followed by a decode step over the fully-prefilled
         slots. That interleaving is what bounds decode ITL during
-        long-prompt admission to one chunk's compute."""
+        long-prompt admission to one chunk's compute.
+
+        On a speculative engine each iteration additionally plans at most
+        ONE verify batch covering every speculating slot that has a
+        non-empty draft and no outstanding steps (spec-mode slots advance
+        at fetch, so in-order slots always satisfy ``n_dispatched ==
+        len(tokens)``; a slot with a draft in hand waits for its
+        pipelined plain steps to drain first). Empty-draft and backed-off
+        slots keep riding the plain pipelined decode step — speculation
+        only ever trades pipelining for verify width when the drafter
+        actually has a proposal."""
         metrics = self.metrics
         with self._cv:
             while True:
@@ -815,6 +916,11 @@ class ContinuousBatcher:
                             slot.chunk_pos = slot.cached_len
                         else:
                             slot.n_dispatched = 1  # prefill's first token
+                        if self._spec_k:
+                            slot.spec = SlotSpec(self._spec_cfg)
+                            slot.prompt_ids = [
+                                int(t) for t in p.payload["input_ids"]
+                            ]
                         slot.slot_id = slot_id
                         self._slots[slot_id] = slot
                         self._n_active += 1
@@ -842,10 +948,94 @@ class ContinuousBatcher:
                         )
                     if planned:
                         chunk_rows = planned
+                verify = None
+                spec_plain: set[int] = set()
+                if self._spec_k:
+                    # One verify batch over every speculating slot.
+                    # Drafting happens here under _cv (the drafter is a
+                    # pure function of slot state). A slot whose draft
+                    # comes up EMPTY takes a plain (pipelined) decode row
+                    # this step instead — a k=0 verify would just be a
+                    # non-overlapped decode step — and the missed
+                    # opportunity feeds the acceptance EMA so undraftable
+                    # streams back off entirely WITHOUT ever paying the
+                    # drain stall: only a slot with a draft actually
+                    # worth verifying waits for its in-flight plain
+                    # steps to land (and re-drafts against the full
+                    # history once they have).
+                    vrows = []
+                    for i, s in enumerate(self._slots):
+                        if (
+                            not self._steppable(s)
+                            or s.spec is None
+                            or not s.spec.speculating
+                            # Prefill token still in flight: drafts anchor
+                            # on the GENERATED history (the match that
+                            # matters most appears right after the first
+                            # token), so don't burn the step on a
+                            # prompt-only draft — wait the one fetch.
+                            or not s.tokens
+                        ):
+                            continue
+                        # Never draft past the generation budget (the
+                        # verified token always emits, so at most
+                        # max_new - emitted - 1 drafts can matter) or
+                        # the cache (positions length..length+d must
+                        # stay writable).
+                        cap = min(
+                            s.max_new - len(s.tokens) - 1,
+                            self._cache_len - 1 - s.length,
+                        )
+                        d = s.spec.propose(s.prompt_ids + s.tokens, cap)
+                        if not d:
+                            flip = s.spec.record(0, 0)
+                            if flip is not None:
+                                self._plan_events.append((
+                                    s.pending.request_id, i, flip,
+                                    s.spec.ema,
+                                ))
+                            spec_plain.add(i)
+                            continue
+                        if s.n_dispatched != len(s.tokens):
+                            # Draft in hand but plain steps still in
+                            # flight: stall one pass to drain (history
+                            # is missing the in-flight tokens, so the
+                            # draft re-proposes once they land).
+                            continue
+                        vrows.append((i, s, d))
+                    if vrows:
+                        n = len(self._slots)
+                        drafts = [[0] * self._spec_k for _ in range(n)]
+                        vlengths = [0] * n
+                        n_input = [0] * n
+                        vtemps = [0.0] * n
+                        vseeds = [0] * n
+                        vtags = []
+                        for i, s, d in vrows:
+                            drafts[i][: len(d)] = [int(t) for t in d]
+                            vlengths[i] = s.length
+                            n_input[i] = len(d) + 1
+                            vtemps[i] = s.temperature
+                            vseeds[i] = s.seed
+                            s.draft = d
+                            s.verifying = True  # length advances at FETCH
+                            vtags.append((i, s.gen))
+                        verify = (
+                            drafts, vlengths, n_input, vtemps, vseeds, vtags
+                        )
                 step = None
                 rows = [
                     (i, s) for i, s in enumerate(self._slots)
                     if self._steppable(s)
+                    # Spec-mode slots route through verify (a probe-due
+                    # backed-off slot drains here too) unless this step's
+                    # draft came up empty; backed-off and empty-draft
+                    # slots ride the pipelined plain path.
+                    and (
+                        s.spec is None
+                        or not s.spec.speculating
+                        or i in spec_plain
+                    )
                 ]
                 if rows:
                     n = len(self._slots)
@@ -862,9 +1052,11 @@ class ContinuousBatcher:
                         s.length += 1         # advances at dispatch: steps
                         s.n_dispatched += 1   # pipeline without the fetch
                         tags.append((i, s.gen))
+                        if s.spec is not None:
+                            s.spec.note_plain_step()  # probe clock
                     step = (lengths, active, temps, seeds, tags)
-                if admissions or chunk_rows or step:
-                    return admissions, chunk_rows, step
+                if admissions or chunk_rows or step or verify:
+                    return admissions, chunk_rows, step, verify
                 self._cv.wait()
 
     def _fail_slots(self, tagged: list[tuple[int, int]],
@@ -917,7 +1109,17 @@ class ContinuousBatcher:
             if work is None:
                 self._completion.put(None)  # unblock the fetch thread
                 return
-            admissions, chunk_rows, step = work
+            admissions, chunk_rows, step, verify = work
+            if self._plan_events:
+                # Backoff flips noted while planning (same thread, so no
+                # lock needed); recorded here, outside _cv.
+                for req_id, slot_id, flip, ema in self._plan_events:
+                    self.recorder.record(
+                        "spec_backoff", req_id, slot=slot_id,
+                        engaged=(flip == "engage"),
+                        acceptance=round(ema, 4),
+                    )
+                self._plan_events.clear()
             if admissions:
                 self.metrics.batches.inc()
                 self.metrics.batch_occupancy.observe(len(admissions))
@@ -1018,6 +1220,26 @@ class ContinuousBatcher:
                             self.metrics.kv_pool_bytes.set(
                                 self._pool.stats()["bytes_used"]
                             )
+            if verify:
+                # Dispatched BEFORE the decode step: the planned verify
+                # rows are parked (verifying=True) and would wedge if a
+                # decode failure's `continue` skipped their dispatch.
+                drafts, vlengths, n_input, vtemps, vseeds, vtags = verify
+                self._inflight_sem.acquire()
+                try:
+                    handle = engine.verify(
+                        drafts, vlengths, n_input, vtemps, vseeds
+                    )
+                except Exception as e:  # noqa: BLE001
+                    self._inflight_sem.release()
+                    self._fail_slots(vtags, e)
+                else:
+                    with self._cv:
+                        self._n_inflight += 1
+                        self.metrics.in_flight.set(self._n_inflight)
+                    self._completion.put(
+                        ("verify", vtags, handle, time.monotonic())
+                    )
             if step:
                 lengths, active, temps, seeds, tags = step
                 self._inflight_sem.acquire()
@@ -1131,6 +1353,9 @@ class ContinuousBatcher:
             itls: list[float] = []
             ttfts: list[float] = []
             n_tokens = 0
+            slot_steps = 0
+            drafted = accepted = v_rejects = 0
+            spec_events: list[tuple[str, int, str, float]] = []
             with self._cv:
                 if kind == "prefill":
                     for r, (slot_id, gen) in enumerate(tags):
@@ -1159,16 +1384,78 @@ class ContinuousBatcher:
                         self._append_token(
                             slot_id, s, int(tok[r]), t_got, finished
                         )
+                elif kind == "verify":
+                    # tok is the [slots, k+1] verified-token matrix. The
+                    # acceptance rule (longest exact-match prefix) is
+                    # recomputed host-side from the slot's own draft; it
+                    # agrees with the device's cumprod-match by
+                    # construction, so the device last_token stays
+                    # coherent without a round-trip.
+                    for slot_id, gen in tags:
+                        s = self._slots[slot_id]
+                        if s is None or s.gen != gen:
+                            continue
+                        slot_steps += 1
+                        s.verifying = False
+                        d = s.draft or []
+                        s.draft = None
+                        m = 0
+                        for t in d:
+                            if int(tok[slot_id, m]) == int(t):
+                                m += 1
+                            else:
+                                break
+                        drafted += len(d)
+                        accepted += m
+                        if m < len(d):
+                            v_rejects += 1
+                        flip = s.spec.record(len(d), m)
+                        if flip is not None:
+                            spec_events.append((
+                                s.pending.request_id, slot_id, flip,
+                                s.spec.ema,
+                            ))
+                        # Rollback is free: host length advances only past
+                        # the accepted run; the k-m rejected K/V entries
+                        # sit beyond `length`, masked dead, and the slot's
+                        # next real tokens overwrite them.
+                        s.length += m + 1
+                        # ITL stays per TOKEN: the emitted run splits the
+                        # step's wall interval into m+1 equal samples.
+                        dt = (t_got - s.t_last_tok) / (m + 1)
+                        for j in range(m + 1):
+                            itls.append(dt)
+                            n_tokens += 1
+                            self._append_token(
+                                slot_id, s, int(tok[slot_id, j]), t_got,
+                                finished,
+                            )
+                            if self._slots[slot_id] is not s:
+                                break  # eos/max_new mid-run: surplus drops
+                        if self._slots[slot_id] is s:
+                            s.n_dispatched = len(s.tokens)
                 else:
                     for slot_id, gen in tags:
                         s = self._slots[slot_id]
                         if s is None or s.gen != gen:
                             continue
+                        slot_steps += 1
                         itls.append(t_got - s.t_last_tok)
                         n_tokens += 1
                         self._append_token(
                             slot_id, s, int(tok[slot_id]), t_got, finished
                         )
+                if kind in ("decode", "verify"):
+                    # tokens_per_step is per SLOT-step (a decode/verify
+                    # execution of one live slot lane), so a plain engine
+                    # reads exactly 1.0 and the ratio isolates the
+                    # speculation win from batch occupancy.
+                    self._steps += slot_steps
+                    self._tokens_emitted += n_tokens
+                    if kind == "verify":
+                        self._spec_drafted += drafted
+                        self._spec_accepted += accepted
+                        self._spec_rejects += v_rejects
                 self._n_inflight -= 1
                 metrics.in_flight.set(self._n_inflight)
                 metrics.slots_active.set(self._n_active)
@@ -1189,6 +1476,38 @@ class ContinuousBatcher:
                     )
                     for dt in itls:
                         metrics.itl.observe(dt)
+            elif kind == "verify":
+                # Same per-token taxonomy as decode_step: the itls list
+                # already carries one sample per EMITTED token (each an
+                # equal split of its slot's step interval), so phase-sum
+                # == wall still holds and ITL percentiles show the
+                # speculation win directly.
+                if self.tracer.enabled:
+                    self.tracer.record(
+                        "verify_step", t_disp, t_got, cat="serve",
+                        args={"rows": len(tags), "drafted": drafted,
+                              "accepted": accepted},
+                    )
+                if itls:
+                    metrics.observe_phase_batch(
+                        "verify_step", itls, self._layout, t_got
+                    )
+                    for dt in itls:
+                        metrics.itl.observe(dt)
+                if drafted:
+                    metrics.draft_tokens.inc(drafted)
+                    metrics.accepted_tokens.inc(accepted)
+                    if metrics.windowed:
+                        metrics.drafted_w.add(float(drafted), t_got)
+                        metrics.accepted_w.add(float(accepted), t_got)
+                if v_rejects:
+                    metrics.spec_rejects.inc(v_rejects)
+                for req_id, slot_id, flip, ema in spec_events:
+                    self.recorder.record(
+                        "spec_backoff", req_id, slot=slot_id,
+                        engaged=(flip == "engage"),
+                        acceptance=round(ema, 4),
+                    )
             elif kind == "chunk":
                 # Batch-level span/phase twin of decode_step: one sample
                 # per chunk dispatch. Per-request phases stay the

@@ -728,6 +728,53 @@ def _make_causal_decode(model, cache_len: int):
     return decode_fn
 
 
+def _make_causal_verify(model, cache_len: int, k: int):
+    """Speculative-verify executable body (ONE shape: the full slot table,
+    ``k+1`` columns): score each verifying slot's last token plus up to
+    ``k`` host-drafted candidates in one forward, sample every column with
+    the SAME (seed, absolute position) keys successive decode steps would
+    use, and compute the accepted prefix on-device so ``last_token`` stays
+    coherent without a host round-trip.
+
+    Column ``j`` of a verifying lane sits at absolute position
+    ``lengths + j``; lanes beyond a slot's ``n_input`` (and every lane of
+    a non-verifying slot, ``n_input == 0``) carry the sentinel position
+    ``cache_len`` so their K/V scatters drop — the decode path's idle-lane
+    invariant, column-wise. Acceptance is exact match: draft ``j`` survives
+    iff it equals the sampled token at column ``j-1`` AND every earlier
+    draft survived (the cumprod), so with ``m`` accepted drafts the lane
+    emits ``m+1`` tokens (``tok[:, :m+1]`` — the first mismatch column IS
+    the verified model token; a full reject still advances one token).
+    K/V written past ``lengths + m`` are dead stores the rolled-back slot
+    position masks; the host rollback is just not advancing its length."""
+
+    def verify_fn(params, ck, cv, last, drafts, lengths, n_input, temps,
+                  seeds):
+        tokens = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, k+1]
+        cols = jnp.arange(k + 1)[None, :]
+        pos = lengths[:, None] + cols
+        wpos = jnp.where(cols < n_input[:, None], pos, cache_len)
+        logits, ck, cv = model.apply(
+            {"params": params}, tokens, wpos, ck, cv, method="verify_step"
+        )
+        # Column j's sampling key is position lengths + j + 1 — exactly the
+        # key the (j+1)-th plain decode step after this point would fold
+        # in, so seeded streams stay bit-identical however many columns
+        # each step accepts.
+        tok = jax.vmap(
+            lambda lg, st: sample_tokens(lg, temps, seeds, st),
+            in_axes=(1, 1), out_axes=1,
+        )(logits, pos + 1)
+        dcols = jnp.arange(k)[None, :]
+        matches = (tok[:, :-1] == drafts) & (dcols < n_input[:, None] - 1)
+        m = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1), axis=1)
+        new_last = tok[jnp.arange(tok.shape[0]), m]
+        last = jnp.where(n_input > 0, new_last, last)
+        return ck, cv, last, tok
+
+    return verify_fn
+
+
 def _make_causal_chunk_prefill(model, cache_len: int):
     """Chunk-prefill executable body for one (tier, chunk bucket): a fused
     page-gather prologue + one absolute-position prompt chunk + on-device
@@ -838,6 +885,12 @@ class CausalLMEngine(_AotEngine):
       step embeds each slot's pending token, extends its pages, samples
       the next token. Idle slots ride along masked; the batcher admits /
       frees between steps without ever touching a compiled shape.
+    - ``verify`` (``spec_tokens > 0`` only) — ONE executable at
+      ``[slots, k+1]``: speculative decoding's batched verify of host-
+      drafted candidates (:func:`_make_causal_verify`), same donation
+      chain and idle-lane masking as decode, timed through
+      ``_compile_cell`` like every other cell so ``/compilez`` and
+      warm-fraction readiness gating see it.
 
     ``last_token`` stays device-resident, so step k+1 dispatches against
     step k's un-fetched output — the host fetch of sampled tokens (finish
@@ -886,6 +939,9 @@ class CausalLMEngine(_AotEngine):
         prefix_cache_mb: float = 0.0,
         block_tokens: int = 16,
         prefill_chunk: int = 0,
+        spec_tokens: int = 0,
+        spec_min_match: int = 2,
+        spec_backoff: float = 0.25,
         memory=None,
     ):
         if slots < 1:
@@ -912,6 +968,22 @@ class CausalLMEngine(_AotEngine):
         self.cache_len = min(self.buckets[-1] + max_new_tokens,
                              cfg.max_position)
         self.max_new_tokens = max_new_tokens
+        # Speculative decoding (serve/spec.py; docs/DEPLOY.md "Speculative
+        # decoding"): k > 0 compiles ONE extra verify executable at
+        # [slots, k+1] and hands the batcher a SpecConfig to draft against.
+        from distributed_tensorflow_tpu.serve.spec import SpecConfig
+
+        self.spec_tokens = self._plan_spec(
+            cfg, tp=tp, spec_tokens=spec_tokens, min_match=spec_min_match,
+            max_new_tokens=max_new_tokens,
+        )
+        self.spec = (
+            SpecConfig(
+                spec_tokens=self.spec_tokens, min_match=spec_min_match,
+                backoff_threshold=spec_backoff,
+            )
+            if self.spec_tokens > 0 else None
+        )
 
         from distributed_tensorflow_tpu.models.causal_lm import (
             causal_param_specs,
@@ -1005,8 +1077,11 @@ class CausalLMEngine(_AotEngine):
         # engine swaps its refs for the returned ones at dispatch.
         self._prefill_compiled = {}
         self._chunk_compiled = {}
+        n_spec_cells = 1 if self.spec_tokens else 0
         if not self._chunked_mode:
-            self._plan_cells(len(self.batch_tiers) * len(self.buckets) + 1)
+            self._plan_cells(
+                len(self.batch_tiers) * len(self.buckets) + 1 + n_spec_cells
+            )
             for T in self.batch_tiers:
                 fn = self._wrap(_make_causal_prefill(self.model), n_batch=6)
                 for L in self.buckets:
@@ -1032,7 +1107,7 @@ class CausalLMEngine(_AotEngine):
         else:
             self._plan_cells(
                 len(self.batch_tiers) * len(self._chunk_buckets) + 1
-                + (1 if self.prefix_cache is not None else 0)
+                + (1 if self.prefix_cache is not None else 0) + n_spec_cells
             )
             chunk_fn = self._wrap_chunk(
                 _make_causal_chunk_prefill(self.model, self.cache_len)
@@ -1104,14 +1179,44 @@ class CausalLMEngine(_AotEngine):
                 .compile()
             ),
         )
+        self._verify_compiled = None
+        if self.spec_tokens:
+            verify_fn = self._wrap(
+                _make_causal_verify(
+                    self.model, self.cache_len, self.spec_tokens
+                ),
+                n_batch=5,
+            )
+            self._verify_compiled = self._compile_cell(
+                f"lm/{self.layout}/verify",
+                lambda: (
+                    jax.jit(verify_fn, donate_argnums=(1, 2, 3))
+                    .lower(
+                        self.params,
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._rep_struct((slots,), jnp.int32),
+                        self._rep_struct(
+                            (slots, self.spec_tokens), jnp.int32
+                        ),
+                        self._rep_struct((slots,), jnp.int32),
+                        self._rep_struct((slots,), jnp.int32),
+                        self._rep_struct((slots,), jnp.float32),
+                        self._rep_struct((slots,), jnp.int32),
+                    )
+                    .compile()
+                ),
+            )
         logger.info(
             "causal-LM engine ready: layout=%s slots=%d cache_len=%d "
-            "buckets=%s tiers=%s chunk=%s pool_blocks=%s (%d executables)",
+            "buckets=%s tiers=%s chunk=%s pool_blocks=%s spec_k=%s "
+            "(%d executables)",
             self.layout, slots, self.cache_len, self.buckets,
             self.batch_tiers, self.prefill_chunk_size or None,
             self.prefix_cache.n_blocks if self.prefix_cache else None,
+            self.spec_tokens or None,
             len(self._prefill_compiled) + len(self._chunk_compiled) + 1
-            + (1 if self.prefix_cache is not None else 0),
+            + (1 if self.prefix_cache is not None else 0) + n_spec_cells,
         )
 
     @staticmethod
@@ -1174,6 +1279,42 @@ class CausalLMEngine(_AotEngine):
                 f"hidden={cfg.hidden_size})"
             )
         return n_blocks, bytes_per_block
+
+    @staticmethod
+    def _plan_spec(cfg, *, tp: int = 1, spec_tokens: int = 0,
+                   min_match: int = 2, max_new_tokens: int = 32) -> int:
+        """Validate the speculation knobs for this config/layout and return
+        the verify width ``k`` (0 = disabled). Raises ``ValueError`` loudly
+        at startup (shardcheck's SC002 sweep crosses layouts with these
+        configs, like ``_plan_prefix_cache``) — a draft window the cache or
+        generation budget can never use must not wait for a request to
+        fail. ``tp`` imposes no extra constraint beyond ``_serve_config``'s
+        head-divisibility (the verify executable shards exactly like
+        decode), but stays in the signature so the sweep exercises every
+        layout through one call shape."""
+        del tp
+        if spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {spec_tokens}"
+            )
+        if spec_tokens == 0:
+            return 0
+        if min_match < 1:
+            raise ValueError(
+                f"spec min_match must be >= 1, got {min_match}"
+            )
+        if spec_tokens >= max_new_tokens:
+            raise ValueError(
+                f"spec_tokens {spec_tokens} >= max_new_tokens "
+                f"{max_new_tokens}: a draft can never exceed the remaining "
+                "generation budget"
+            )
+        if spec_tokens + 1 > cfg.max_position:
+            raise ValueError(
+                f"spec_tokens {spec_tokens} + 1 exceeds max_position "
+                f"{cfg.max_position}"
+            )
+        return int(spec_tokens)
 
     def _cache_struct(self, shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype,
@@ -1481,9 +1622,61 @@ class CausalLMEngine(_AotEngine):
             buffers=buffers, layout=self.layout, t_assembled=t_assembled,
         )
 
+    def verify(self, drafts, lengths, n_input, temps, seeds) -> InFlightBatch:
+        """Dispatch ONE speculative verify step over the full slot table.
+
+        ``drafts [slots, k]``: host-proposed candidate tokens;
+        ``n_input``: drafted+1 for verifying lanes, 0 for everyone else
+        (idle slots AND slots riding the plain-decode path this step).
+        Unlike ``decode``, the batcher advances a verifying slot's length
+        at FETCH, not dispatch — the accepted count is data-dependent — so
+        a verifying slot never re-dispatches until its verdict lands.
+        Returns without blocking; ``fetch_step`` yields the [slots, k+1]
+        sampled-token matrix (the host re-derives the accepted prefix from
+        its own drafts)."""
+        if self._verify_compiled is None:
+            raise RuntimeError(
+                "engine built without speculation (spec_tokens=0)"
+            )
+        key = ("verify",)
+
+        def _make():
+            s = self.slots
+            return (
+                np.zeros((s, self.spec_tokens), np.int32),
+                np.zeros((s,), np.int32),
+                np.zeros((s,), np.int32),
+                np.zeros((s,), np.float32),
+                np.zeros((s,), np.int32),
+            )
+
+        bdr, blen, bnin, btmp, bseed = buffers = self._take_buffers(
+            key, _make
+        )
+        np.copyto(bdr, drafts)
+        np.copyto(blen, lengths)
+        np.copyto(bnin, n_input)
+        np.copyto(btmp, temps)
+        np.copyto(bseed, seeds)
+        t_assembled = time.monotonic()
+        ck, cv, last, tok = self._verify_compiled(
+            self.params, self._cache_k, self._cache_v, self._last_token,
+            jax.device_put(bdr, self._rep), jax.device_put(blen, self._rep),
+            jax.device_put(bnin, self._rep), jax.device_put(btmp, self._rep),
+            jax.device_put(bseed, self._rep),
+        )
+        self._cache_k, self._cache_v, self._last_token = ck, cv, last
+        return InFlightBatch(
+            out={"tok": tok}, key=key, n=int(np.sum(bnin > 0)), meta=None,
+            buffers=buffers, layout=self.layout, t_assembled=t_assembled,
+        )
+
     def fetch_step(self, inflight: InFlightBatch) -> np.ndarray:
-        """Block on a step's sampled-token vector (the ONLY device_get on
-        the decode path — everything else stays resident)."""
+        """Block on a step's sampled-token vector — or a verify step's
+        [slots, k+1] token matrix — the ONLY device_get on the decode path
+        (everything else stays resident; analysis/baseline.json designates
+        this method for JL003, and the verify path reuses it rather than
+        growing a second blocking point)."""
         tok = np.asarray(jax.device_get(inflight.out["tok"]))
         inflight.t_got = time.monotonic()
         self._give_buffers(inflight.key, inflight.buffers)

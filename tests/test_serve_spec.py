@@ -1,0 +1,488 @@
+"""Speculative-decoding tests: drafting, verify-k numerics, and backoff.
+
+The invariant everything here pins is BIT-PARITY: with exact-match
+acceptance, a speculative engine's token stream equals the non-speculative
+stream (and the one-shot full-forward reference) token for token — greedy
+and seeded-sampling, 1-chip and TP-sharded, with and without the prefix
+cache, through accepted runs AND rejects. The tiny test model's greedy
+continuation is position-dominated, so ``_predictive_prompt`` builds a
+fixed-point prompt that embeds the model's own continuation — the n-gram
+drafter then predicts it and speculation genuinely engages; random prompts
+exercise the adversarial path (empty drafts -> adaptive backoff).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+from distributed_tensorflow_tpu.obs.metrics import ServeMetrics
+from distributed_tensorflow_tpu.obs.sanitizer import sanitize_races
+from distributed_tensorflow_tpu.serve import batcher as batcher_mod
+from distributed_tensorflow_tpu.serve import (
+    BatcherConfig,
+    ContinuousBatcher,
+    NGramDrafter,
+)
+from distributed_tensorflow_tpu.serve.spec import SlotSpec, SpecConfig
+
+# ---------------------------------------------------------------- fixtures
+
+
+def _tiny_causal_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+
+    cfg = CausalLMConfig(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=2,
+        intermediate_size=64,
+        max_position=48,
+    )
+    model = CausalLM(cfg)
+    L = cfg.max_position
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, L), jnp.int32),
+        jnp.ones((1, L), bool),
+    )
+    return model, variables["params"]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm(devices8):
+    return _tiny_causal_lm()
+
+
+@pytest.fixture(scope="module")
+def spec_engine(tiny_lm):
+    from distributed_tensorflow_tpu.serve import CausalLMEngine
+
+    model, params = tiny_lm
+    return CausalLMEngine(
+        model, params, buckets=(8, 16), slots=3, max_batch=2,
+        max_new_tokens=8, spec_tokens=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def plain_engine(tiny_lm):
+    from distributed_tensorflow_tpu.serve import CausalLMEngine
+
+    model, params = tiny_lm
+    return CausalLMEngine(
+        model, params, buckets=(8, 16), slots=3, max_batch=2,
+        max_new_tokens=8,
+    )
+
+
+@pytest.fixture(scope="module")
+def tp_spec_engine(tiny_lm):
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.serve import (
+        CausalLMEngine,
+        plan_serve_mesh,
+    )
+
+    model, params = tiny_lm
+    spec, fell_back = plan_serve_mesh(tp=2, n_devices=8)
+    assert not fell_back
+    return CausalLMEngine(
+        model, params, build_mesh(spec), buckets=(8, 16), slots=3,
+        max_batch=2, max_new_tokens=8, spec_tokens=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def spec_prefix_engine(tiny_lm):
+    from distributed_tensorflow_tpu.serve import CausalLMEngine
+
+    model, params = tiny_lm
+    return CausalLMEngine(
+        model, params, buckets=(8, 16), slots=3, max_batch=2,
+        max_new_tokens=8, prefix_cache_mb=0.05, block_tokens=4,
+        prefill_chunk=8, spec_tokens=3,
+    )
+
+
+def _ref_greedy(model, params, prompt, n):
+    """One-shot reference: n greedy tokens by re-running the FULL causal
+    forward after each appended token — no cache, no speculation."""
+    import jax.numpy as jnp
+
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        x = jnp.asarray([toks], jnp.int32)
+        logits = model.apply(
+            {"params": params}, x, jnp.ones((1, len(toks)), bool)
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _predictive_prompt(model, params, seed, n_new=6):
+    """Fixed-point prompt the n-gram drafter can predict: embed the
+    model's OWN greedy continuation after a marker token ``t``, ending the
+    prompt with ``t`` again — once the first token generates, the suffix
+    [t, c0] matches the embedded occurrence and the drafter proposes the
+    very tokens the model is about to emit. Iterated to a fixed point
+    because embedding the continuation changes the prompt (the tiny
+    model's output is position-dominated, so this converges fast)."""
+    rng = np.random.default_rng(seed)
+    t = int(rng.integers(5, 64))
+    c = _ref_greedy(model, params, rng.integers(5, 64, size=12), n_new)
+    for _ in range(6):
+        p = [int(rng.integers(5, 64)), t] + c + [
+            int(x) for x in rng.integers(5, 64, size=12 - 3 - len(c))
+        ] + [t]
+        c2 = _ref_greedy(model, params, p, n_new)
+        if c2 == c:
+            break
+        c = c2
+    return np.array(p, np.int32), c
+
+
+def _reject_prompt(model, params, seed, n_new=6):
+    """Like :func:`_predictive_prompt` but the tokens embedded after
+    [t, c0] are deliberately WRONG (shifted mod vocab, provably != the
+    true continuation) — the drafter proposes them, verify rejects at the
+    first column, and the step must still emit the verified model token."""
+    rng = np.random.default_rng(seed)
+    t = int(rng.integers(5, 64))
+    c = _ref_greedy(model, params, rng.integers(5, 64, size=12), n_new)
+    for _ in range(8):
+        wrong = [(ci - 5 + 7) % 59 + 5 for ci in c[1:4]]
+        p = [int(rng.integers(5, 64)), t, c[0]] + wrong + [
+            int(x) for x in rng.integers(5, 64, size=5)
+        ] + [t]
+        c2 = _ref_greedy(model, params, p, n_new)
+        if c2 == c:
+            break
+        c = c2
+    return np.array(p, np.int32), c
+
+
+# ------------------------------------------------------- drafter unit tests
+
+
+def test_ngram_drafter_prefers_longest_then_most_recent():
+    d = NGramDrafter(min_match=2, max_match=4)
+    # [1,2,3] occurs twice; the suffix [1,2,3] must match the most RECENT
+    # earlier occurrence (followed by 9), not the first (followed by 7).
+    h = [1, 2, 3, 7, 1, 2, 3, 9, 5, 1, 2, 3]
+    assert d.draft(h, 2) == [9, 5]
+    # Longest-first: suffix [3, 9, 5] (width 3) beats any width-2 match.
+    h2 = [3, 9, 5, 8, 6, 9, 5, 4, 3, 9, 5]
+    assert d.draft(h2, 1) == [8]
+
+
+def test_ngram_drafter_empty_cases():
+    d = NGramDrafter(min_match=2, max_match=4)
+    assert d.draft([1, 2, 3, 4], 3) == []        # no repeated suffix
+    assert d.draft([1, 2], 3) == []              # history too short
+    assert d.draft([1, 2, 3, 1, 2, 3], 0) == []  # k=0
+    # Proposal is clamped to at most k tokens.
+    h = [1, 2, 9, 8, 7, 6, 1, 2]
+    assert d.draft(h, 2) == [9, 8]
+
+
+def test_ngram_drafter_validation():
+    with pytest.raises(ValueError):
+        NGramDrafter(min_match=0)
+    with pytest.raises(ValueError):
+        NGramDrafter(min_match=3, max_match=2)
+
+
+# ------------------------------------------------------ SlotSpec unit tests
+
+
+def test_slot_spec_backoff_engages_and_reprobes():
+    cfg = SpecConfig(
+        spec_tokens=4, warmup_verifies=3, backoff_threshold=0.25,
+        ema_alpha=0.3, reprobe_period=4,
+    )
+    s = SlotSpec(cfg)
+    assert s.speculating
+    # Empty drafts fold in as 0.0 acceptance: EMA 1.0->.7->.49->.343->.24,
+    # crossing the threshold (and the warmup floor) on the 4th record.
+    flips = [s.record(0, 0) for _ in range(4)]
+    assert flips == [None, None, None, "engage"]
+    assert s.backed_off and not s.speculating
+    # A probe becomes due after reprobe_period plain steps...
+    for _ in range(4):
+        s.note_plain_step()
+    assert s.speculating
+    # ...and a fully-accepted probe lifts the EMA back over the line.
+    assert s.record(4, 4) == "disengage"
+    assert not s.backed_off and s.speculating
+
+
+def test_slot_spec_reject_bookkeeping():
+    s = SlotSpec(SpecConfig(spec_tokens=4))
+    s.record(3, 3)
+    s.record(3, 1)
+    s.record(0, 0)
+    assert (s.drafted, s.accepted, s.rejects) == (6, 4, 1)
+    dg = s.digest()
+    assert dg["k"] == 4 and not dg["backed_off"]
+
+
+# --------------------------------------------------- engine plan validation
+
+
+def test_plan_spec_rejects_bad_configs(tiny_lm):
+    from distributed_tensorflow_tpu.serve import CausalLMEngine
+
+    model, params = tiny_lm
+    for bad in (
+        {"spec_tokens": -1},
+        {"spec_tokens": 2, "spec_min_match": 0},
+        {"spec_tokens": 8},   # k >= max_new_tokens: verify can't fit
+    ):
+        with pytest.raises(ValueError):
+            CausalLMEngine(
+                model, params, buckets=(8,), slots=2, max_batch=1,
+                max_new_tokens=8, **bad,
+            )
+
+
+# ------------------------------------------------- numerics: greedy parity
+
+
+def _run_spec_mix(engine, model, params):
+    """Predictive prompts (drafter succeeds) + an adversarial random one
+    (drafter finds nothing), greedy, through the continuous batcher: every
+    stream must equal the speculation-free full-forward reference, and
+    speculation must have genuinely engaged (accepted tokens > 0)."""
+    reqs, refs = [], []
+    for seed in (3, 5, 9):
+        p, c = _predictive_prompt(model, params, seed)
+        reqs.append({"input_ids": p, "max_new_tokens": len(c)})
+        refs.append(c)
+    rnd = np.random.default_rng(0).integers(5, 64, size=10)
+    reqs.append({"input_ids": rnd, "max_new_tokens": 6})
+    refs.append(_ref_greedy(model, params, rnd, 6))
+    m = ServeMetrics()
+    with ContinuousBatcher(
+        engine, BatcherConfig(max_batch=2, max_queue=32), metrics=m
+    ) as b:
+        futs = [b.submit(dict(r)) for r in reqs]
+        results = [f.result(timeout=120) for f in futs]
+        st = b.status()
+    for r, ref in zip(results, refs):
+        assert r["tokens"] == ref
+    return m, st
+
+
+def test_spec_greedy_parity_single_chip(spec_engine, tiny_lm):
+    model, params = tiny_lm
+    m, st = _run_spec_mix(spec_engine, model, params)
+    snap = m.snapshot()
+    assert snap["accepted_tokens"] > 0          # speculation really ran
+    assert snap["draft_tokens"] >= snap["accepted_tokens"]
+    sp = st["speculation"]
+    assert sp["spec_tokens"] == 3
+    assert sp["mode_k"] == {"speculating": 3, "backed_off": 0}
+    assert sp["accepted_tokens"] == snap["accepted_tokens"]
+    # Accepted runs emit multiple tokens per slot-step; the plain path
+    # emits exactly one — the ratio is the speculation win.
+    assert st["tokens_per_step"] > 1.0
+
+
+def test_spec_greedy_parity_tp_mesh(tp_spec_engine, tiny_lm):
+    """Acceptance: identical streams when the verify executable shards
+    params and cache heads over a model axis (dp4-tp2 on 8 devices)."""
+    model, params = tiny_lm
+    assert tp_spec_engine.layout != ""
+    m, _ = _run_spec_mix(tp_spec_engine, model, params)
+    assert m.snapshot()["accepted_tokens"] > 0
+
+
+def test_plain_engine_tokens_per_step_is_one(plain_engine, tiny_lm):
+    """The per-slot-step accounting baseline: without speculation every
+    live slot advances exactly one token per step, so the gauge is 1.0
+    regardless of batch occupancy."""
+    model, params = tiny_lm
+    p, c = _predictive_prompt(model, params, 3)
+    with ContinuousBatcher(plain_engine, BatcherConfig(max_batch=2)) as b:
+        r = b.submit({"input_ids": p, "max_new_tokens": len(c)}).result(
+            timeout=120
+        )
+        st = b.status()
+    assert r["tokens"] == c
+    assert st["tokens_per_step"] == pytest.approx(1.0)
+    assert "speculation" not in st
+
+
+# --------------------------------------------- rollback, sampling, backoff
+
+
+def test_kv_rollback_parity_after_reject(spec_engine, tiny_lm):
+    """A rejected draft must leave no trace: the verify wrote k+1 cache
+    positions but only m+1 survive (slot length advances past exactly the
+    accepted prefix; stale pages are masked dead) — the continuation after
+    a reject must match the never-speculated reference bit for bit."""
+    model, params = tiny_lm
+    p, c = _reject_prompt(model, params, seed=21)
+    m = ServeMetrics()
+    with ContinuousBatcher(
+        spec_engine, BatcherConfig(max_batch=2), metrics=m
+    ) as b:
+        r = b.submit({"input_ids": p, "max_new_tokens": len(c)}).result(
+            timeout=120
+        )
+    assert r["tokens"] == c
+    snap = m.snapshot()
+    assert snap["spec_rejects"] >= 1            # the wrong draft was tried
+    assert snap["draft_tokens"] > snap["accepted_tokens"]
+
+
+def test_seeded_sampling_parity_with_spec(spec_engine, plain_engine, tiny_lm):
+    """temperature > 0 with speculation: sampling is keyed on (seed,
+    absolute position), so exact-match acceptance preserves the stream for
+    ANY temperature — spec-on must equal spec-off run for run, across
+    whatever acceptance pattern the sampled tokens produce."""
+    model, params = tiny_lm
+    p, _ = _predictive_prompt(model, params, 7)
+    reqs = [
+        {"input_ids": p, "max_new_tokens": 6, "temperature": 0.8,
+         "seed": 123},
+        {"input_ids": np.arange(5, 15), "max_new_tokens": 5,
+         "temperature": 1.2, "seed": 7},
+    ]
+    outs = []
+    for engine in (plain_engine, spec_engine, spec_engine):
+        with ContinuousBatcher(engine, BatcherConfig(max_batch=2)) as b:
+            futs = [b.submit(dict(r)) for r in reqs]
+            outs.append([f.result(timeout=120)["tokens"] for f in futs])
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_eos_inside_accepted_run(spec_engine, tiny_lm):
+    """EOS produced mid-run by an accepted draft must stop the stream at
+    the EOS token exactly as plain decode would."""
+    model, params = tiny_lm
+    p, c = _predictive_prompt(model, params, 3)
+    with ContinuousBatcher(spec_engine, BatcherConfig(max_batch=2)) as b:
+        r = b.submit({
+            "input_ids": p, "max_new_tokens": len(c), "eos_id": c[2],
+        }).result(timeout=120)
+    assert r["tokens"] == c[:3]
+    assert r["n_tokens"] == 3
+
+
+def test_adaptive_backoff_on_random_stream(spec_engine, tiny_lm):
+    """An undraftable stream (strictly increasing prompt: no n-gram ever
+    repeats) must fold empty proposals into the EMA and back off to plain
+    pipelined decode, surfacing a spec_backoff event — and still match the
+    reference."""
+    model, params = tiny_lm
+    prompt = np.arange(5, 19)       # all-distinct: drafter can never match
+    ref = _ref_greedy(model, params, prompt, 8)
+    rec = FlightRecorder(capacity=256)
+    with ContinuousBatcher(
+        spec_engine, BatcherConfig(max_batch=2), recorder=rec
+    ) as b:
+        r = b.submit({"input_ids": prompt, "max_new_tokens": 8}).result(
+            timeout=120
+        )
+        st = b.status()
+    assert r["tokens"] == ref
+    backoffs = [e for e in rec.events() if e["kind"] == "spec_backoff"]
+    assert any(e["engaged"] for e in backoffs)
+    assert st["speculation"]["draft_tokens"] == 0   # nothing ever proposed
+
+
+# ------------------------------------------- prefix-cache + spec composition
+
+
+def test_prefix_cache_composes_with_speculation(spec_prefix_engine, tiny_lm):
+    """Cached head + speculated tail: requests sharing a predictive
+    prompt's head gather pool pages on admission AND speculate during
+    decode — both optimizations active, stream still bit-exact."""
+    model, params = tiny_lm
+    p, c = _predictive_prompt(model, params, 5)
+    req = {"input_ids": p, "max_new_tokens": len(c)}
+    m = ServeMetrics()
+    with ContinuousBatcher(
+        spec_prefix_engine, BatcherConfig(max_batch=2), metrics=m
+    ) as b:
+        # Sequential warm publishes the head's pages.
+        assert b.submit(dict(req)).result(timeout=120)["tokens"] == c
+        futs = [b.submit(dict(req)) for _ in range(3)]
+        for f in futs:
+            assert f.result(timeout=120)["tokens"] == c
+    snap = m.snapshot()
+    assert m.prefix_hits.value >= 3             # replays hit the head
+    assert snap["accepted_tokens"] > 0          # and still speculated
+
+
+# ------------------------------------------------- sanitizer soak
+
+
+def test_speculative_batcher_race_soak(spec_engine, tiny_lm):
+    """Concurrent submitters through the REAL speculative engine under the
+    race sanitizer: the new slot fields (spec state, draft, verifying) and
+    the spec counters must stay happens-before ordered under the verify /
+    decode / plan interleaving, with every stream exact."""
+    model, params = tiny_lm
+    preds = [_predictive_prompt(model, params, s) for s in (3, 5)]
+    with sanitize_races(modules=[batcher_mod]) as san:
+        b = ContinuousBatcher(
+            spec_engine, BatcherConfig(max_batch=2, max_queue=64)
+        )
+        results = {}
+        errs = []
+
+        def worker(base):
+            rng = np.random.default_rng(base)
+            try:
+                futs = []
+                for i in range(4):
+                    if i % 2 == 0:
+                        p, c = preds[base % len(preds)]
+                        futs.append((c, b.submit({
+                            "input_ids": p, "max_new_tokens": len(c),
+                        })))
+                    else:
+                        prompt = rng.integers(5, 64, size=10)
+                        n = int(rng.integers(2, 7))
+                        futs.append((
+                            _ref_greedy(model, params, prompt, n),
+                            b.submit({
+                                "input_ids": prompt, "max_new_tokens": n,
+                            }),
+                        ))
+                for j, (ref, f) in enumerate(futs):
+                    results[(base, j)] = (f.result(timeout=120)["tokens"], ref)
+            except Exception as e:  # pragma: no cover - surfaced via errs
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(base,))
+            for base in (1, 2, 3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        b.close()
+        assert not errs
+        assert len(results) == 12
+        for got, ref in results.values():
+            assert got == ref
+        assert san.accesses > 0
+        san.assert_clean()
