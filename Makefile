@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick chaos-quick
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -31,6 +31,16 @@ obs-quick:
 # docs/PERF.md round 14).
 decode-quick:
 	$(PY) scripts/serve_bench.py --decode --quick
+
+# Survive-the-cluster gate (~30s): the fault-injection/preemption/elastic
+# re-mesh unit suite plus the 2-process chaos rehearsal — seeded FaultPlan
+# SIGKILLs worker 0 mid-run, the FleetSupervisor rules re_mesh from the
+# beacons, and the resumed run (with a further feeder fault) must match an
+# uninterrupted run from the same async checkpoint step for step.
+chaos-quick:
+	$(PY) -m pytest tests/test_resilience.py -q
+	$(PY) -m pytest tests/test_multiprocess.py::test_two_process_chaos_sigkill_resume \
+	    -q -m slow
 
 # Static analysis + config sweep over the package; nonzero exit on any
 # non-baselined finding or stale baseline entry.

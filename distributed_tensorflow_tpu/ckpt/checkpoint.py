@@ -35,7 +35,9 @@ class Checkpointer:
         *,
         max_to_keep: int = 3,
         use_async: bool = True,
+        fault_injector=None,
     ):
+        self._injector = fault_injector
         self._mgr = ocp.CheckpointManager(
             Path(directory).absolute(),
             options=ocp.CheckpointManagerOptions(
@@ -45,7 +47,14 @@ class Checkpointer:
         )
 
     def save(self, step: int, state: Any, *, force: bool = False) -> None:
-        """Queue an async save of ``state`` at ``step`` (non-blocking)."""
+        """Queue an async save of ``state`` at ``step`` (non-blocking).
+
+        ``fault_injector`` (train/faultinject.py) may raise a scheduled
+        ``ckpt_write_error`` here — the transient-storage failure class
+        ``train/resilience.py``'s save wrapper absorbs.
+        """
+        if self._injector is not None:
+            self._injector.check_ckpt_save(step)
         self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
 
     def latest_step(self) -> int | None:

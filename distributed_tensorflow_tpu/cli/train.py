@@ -829,13 +829,59 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         grad_accum=cfg.grad_accum,
     )
 
-    ckpt = Checkpointer(args.ckpt_dir) if args.ckpt_dir else None
+    # Flight recorder (--dump-dir, obs/flightrec.py): train records almost
+    # nothing per step (the hot loop stays clean), but the resilience/
+    # fault-injection paths record their events here and an unhandled
+    # failure dumps the ring for postmortem. Built BEFORE the checkpointer
+    # and feed so the injector hooks below can carry it.
+    recorder = None
+    dump_dir = getattr(args, "dump_dir", "") or ""
+    if dump_dir:
+        from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+        from distributed_tensorflow_tpu.obs.memory import default_registry
+
+        recorder = FlightRecorder(dump_dir=dump_dir)
+        recorder.attach(memz_fn=default_registry().snapshot)
+
+    # Deterministic fault injection (--fault-plan, train/faultinject.py):
+    # a seeded schedule of slow_step/feeder_error/nonfinite_loss/
+    # ckpt_write_error/host_drop events carried into the loop, the feed
+    # stage, and the checkpointer. Chaos rehearsals reproduce from the
+    # same spec string.
+    fault_injector = None
+    fault_plan_spec = getattr(args, "fault_plan", "") or ""
+    if fault_plan_spec:
+        from distributed_tensorflow_tpu.train.faultinject import (
+            FaultInjector,
+            FaultPlan,
+        )
+
+        plan = FaultPlan.parse(fault_plan_spec, num_steps=cfg.num_steps)
+        fault_injector = FaultInjector(plan, recorder=recorder)
+        logging.info(
+            "fault plan armed: %d scheduled events", len(plan.events)
+        )
+
+    resilient = bool(getattr(args, "resilient", False))
+    if resilient and cfg.device_pool > 0:
+        raise SystemExit(
+            "--resilient does not compose with --device-pool (the pool is "
+            "rebuilt per restart and would replay positions 0..N-1)"
+        )
+    ckpt = (
+        Checkpointer(args.ckpt_dir, fault_injector=fault_injector)
+        if args.ckpt_dir
+        else None
+    )
     start = 0
     if ckpt is not None:
         state, start = ckpt.restore_latest(state)
     # Resume-correct stream: batches start at N, not 0 (the fix for the
-    # reference-era replay-on-restart).
-    batches = pieces["batches"](0 if cfg.device_pool > 0 else start)
+    # reference-era replay-on-restart). Resilient mode builds its streams
+    # through make_batches below instead (one per restart segment).
+    batches = None
+    if not resilient:
+        batches = pieces["batches"](0 if cfg.device_pool > 0 else start)
     if cfg.device_pool > 0:
         # Device-resident pool: materialize the first N batches in HBM once
         # and cycle — the host (and on this platform, the tunnel) leaves the
@@ -870,12 +916,15 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
     from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
 
     feed_metrics = FeedMetrics()
-    if cfg.device_pool <= 0:
+    if cfg.device_pool <= 0 and not resilient:
         # Async feed stage: assembly + host->device transfer run on a
         # feeder thread, cfg.prefetch batches ahead (0 = synchronous with
         # the same metrics surface). Device-pool runs skip it — the pool is
         # already resident in HBM, there is nothing to overlap.
-        batches = prefetch(batches, cfg.prefetch, metrics=feed_metrics)
+        batches = prefetch(
+            batches, cfg.prefetch, metrics=feed_metrics,
+            fault_injector=fault_injector,
+        )
 
     evaluate = None
     if args.eval_every and pieces.get("metric_fn") and pieces.get("eval_batches"):
@@ -917,7 +966,10 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         from distributed_tensorflow_tpu.obs.fleet import HostBeacon, StepTimeline
 
         timeline = StepTimeline()
-        beacon = HostBeacon(beacon_dir, jax.process_index(), timeline)
+        beacon = HostBeacon(
+            beacon_dir, jax.process_index(), timeline,
+            extras=fault_injector.summary if fault_injector is not None else None,
+        )
 
         def beacon_hook(step_: int, state_, metrics_: dict) -> None:
             beacon.write()
@@ -944,20 +996,8 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
         if args.profile_dir
         else contextlib.nullcontext()
     )
-    # Flight recorder (--dump-dir, obs/flightrec.py): train records almost
-    # nothing per step (the hot loop stays clean), but an unhandled failure
-    # dumps the ckpt/memz/tracer context for postmortem.
-    recorder = None
-    dump_dir = getattr(args, "dump_dir", "") or ""
-    if dump_dir:
-        from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
-        from distributed_tensorflow_tpu.obs.memory import default_registry
-
-        recorder = FlightRecorder(dump_dir=dump_dir)
-        recorder.attach(
-            memz_fn=default_registry().snapshot,
-            tracer_fn=tracer.summary if tracer is not None else None,
-        )
+    if recorder is not None and tracer is not None:
+        recorder.attach(tracer_fn=tracer.summary)
     try:
         with profile_cm as win:
             step_fn = step
@@ -970,10 +1010,7 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
                     win.after_step(out)
                     return out
 
-            state, last = fit(
-                state,
-                step_fn,
-                batches,
+            common = dict(
                 num_steps=cfg.num_steps,
                 rng=make_rng(args.seed, args.rng_impl),
                 log_every=cfg.log_every,
@@ -985,7 +1022,52 @@ def run(cfg: WorkloadConfig, args: argparse.Namespace):
                 feed_metrics=feed_metrics,
                 tracer=tracer,
                 timeline=timeline,
+                recorder=recorder,
+                nonfinite=getattr(args, "nonfinite", "abort") or "abort",
             )
+            if resilient:
+                # Preemption-safe supervision (train/resilience.py):
+                # SIGTERM/SIGINT -> final sync checkpoint + clean exit;
+                # transient feeder/ckpt-IO failures restore from the last
+                # checkpoint and re-enter the loop with backoff.
+                from distributed_tensorflow_tpu.train.resilience import (
+                    ResilienceConfig,
+                    run_resilient,
+                )
+
+                def make_batches(start_step: int):
+                    return prefetch(
+                        pieces["batches"](start_step),
+                        cfg.prefetch,
+                        metrics=feed_metrics,
+                        fault_injector=fault_injector,
+                    )
+
+                report = run_resilient(
+                    state,
+                    step_fn,
+                    make_batches,
+                    config=ResilienceConfig(
+                        max_restarts=getattr(args, "max_restarts", 3)
+                    ),
+                    fault_injector=fault_injector,
+                    **common,
+                )
+                state, last = report.state, report.metrics
+                if report.preempted:
+                    logging.info(
+                        "preempted at step %d after %d restart(s); "
+                        "checkpoint is durable",
+                        report.final_step, report.restarts,
+                    )
+            else:
+                state, last = fit(
+                    state,
+                    step_fn,
+                    batches,
+                    fault_injector=fault_injector,
+                    **common,
+                )
         if ckpt is not None and ckpt.latest_step() != int(state.step):
             ckpt.save(int(state.step), state, force=True)
     except Exception as e:
@@ -1121,6 +1203,33 @@ def main(argv: list[str] | None = None):
                         "training failure writes one timestamped JSON with "
                         "the event ring + memory/tracer digests (see "
                         "OBS.md \"Flight recorder\"; empty = disabled)")
+    parser.add_argument("--resilient", action="store_true",
+                        help="preemption-safe supervised training "
+                        "(train/resilience.py): SIGTERM/SIGINT triggers a "
+                        "final synchronous checkpoint + clean exit; "
+                        "transient feeder/checkpoint-IO failures restore "
+                        "from the last checkpoint and retry with capped "
+                        "exponential backoff; non-finite loss and shape "
+                        "errors stay fatal (with a flight-recorder dump "
+                        "when --dump-dir is set)")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="consecutive no-progress restart budget for "
+                        "--resilient (a restart that resumes from a newer "
+                        "checkpoint resets the count)")
+    parser.add_argument("--fault-plan", default="",
+                        help="deterministic fault injection "
+                        "(train/faultinject.py): either a seeded spec like "
+                        "'seed=7,feeder_error=2,ckpt_write_error=1,"
+                        "slow_step=1,slow_step_s=0.1' or a path to a JSON "
+                        "plan; scheduled events fire in the train loop, "
+                        "the feed stage, and the checkpointer, and are "
+                        "recorded to the flight recorder and host beacon")
+    parser.add_argument("--nonfinite", default="abort",
+                        choices=["abort", "skip"],
+                        help="NaN/Inf step-loss policy, checked at the log "
+                        "cadence: abort (default) raises NonFiniteLossError "
+                        "(+ flight-recorder event and forced dump with "
+                        "--dump-dir); skip records the event and trains on")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--rng-impl",

@@ -208,20 +208,29 @@ class HostBeacon:
         host_id: int,
         timeline: StepTimeline,
         window_s: float = 60.0,
+        extras=None,
     ):
         self.dir = Path(beacon_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.host_id = int(host_id)
         self.timeline = timeline
         self.window_s = window_s
+        # extras() -> dict, merged into every summary — e.g. a
+        # FaultInjector's fired-event ledger (train/faultinject.py), so a
+        # chaos run's injections travel the same signal path real
+        # degradation would.
+        self.extras = extras
         self.path = self.dir / f"host_{self.host_id}.json"
 
     def summary(self) -> dict:
-        return {
+        out = {
             "host": self.host_id,
             "wall_time": time.time(),
             **self.timeline.summary(self.window_s),
         }
+        if self.extras is not None:
+            out.update(self.extras())
+        return out
 
     def write(self) -> Path:
         tmp = self.path.with_suffix(".json.tmp")
@@ -289,3 +298,92 @@ def fleet_summary(beacons: list[dict], ratio: float = 2.0) -> dict:
         "straggler_ratio": ratio,
         "hosts": hosts,
     }
+
+
+class FleetSupervisor:
+    """Beacon consumer deciding restart-vs-re-mesh (the reaction half).
+
+    Poll-based and threadless like everything else here: call
+    :meth:`poll` from wherever the beacon files are visible (a monitor, a
+    relaunch wrapper, the chaos test harness). Per poll, each expected
+    host is classified by its beacon's ``wall_time`` freshness:
+
+    - a host with no beacon, or one older than ``heartbeat_timeout_s``,
+      is **lost** → ``action: "re_mesh"`` — the survivors should replan
+      onto the remaining devices
+      (``parallel.mesh.plan_elastic_mesh(surviving)``) and resume via
+      ``train.resilience.run_resilient``;
+    - no losses but a fleet straggler (cross-host-relative, see
+      :func:`detect_fleet_stragglers`) → ``action: "restart"`` — same
+      topology, restart the slow host before it drags the collective;
+    - otherwise ``action: "none"``.
+
+    ``expected_hosts`` is an int (hosts 0..n-1) or an iterable of ids;
+    without it, every host EVER seen is expected — a beacon that appears
+    and then goes stale still counts as lost. Newly-lost hosts are
+    recorded to ``recorder`` as ``host_lost`` events (once per loss, not
+    per poll).
+    """
+
+    def __init__(
+        self,
+        beacon_dir: str | Path,
+        *,
+        expected_hosts=None,
+        heartbeat_timeout_s: float = 30.0,
+        straggler_ratio: float = 2.0,
+        clock=time.time,
+        recorder=None,
+    ):
+        self.dir = Path(beacon_dir)
+        if isinstance(expected_hosts, int):
+            expected_hosts = range(expected_hosts)
+        self.expected: set[int] | None = (
+            {int(h) for h in expected_hosts} if expected_hosts is not None else None
+        )
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.straggler_ratio = straggler_ratio
+        self._clock = clock
+        self._recorder = recorder
+        self._seen: set[int] = set()
+        self._reported_lost: set[int] = set()
+
+    def poll(self, now: float | None = None) -> dict:
+        """One classification pass over the beacon directory."""
+        now = self._clock() if now is None else now
+        by_host = {}
+        for b in read_beacons(self.dir):
+            try:
+                by_host[int(b["host"])] = b
+            except (KeyError, TypeError, ValueError):
+                continue
+        self._seen |= set(by_host)
+        expected = self.expected if self.expected is not None else self._seen
+        alive, lost = [], []
+        for h in sorted(expected):
+            b = by_host.get(h)
+            age = now - b.get("wall_time", 0.0) if b is not None else None
+            if b is None or age > self.heartbeat_timeout_s:
+                lost.append(h)
+            else:
+                alive.append(h)
+        stragglers = detect_fleet_stragglers(
+            [by_host[h] for h in alive], self.straggler_ratio
+        )
+        if self._recorder is not None:
+            for h in lost:
+                if h not in self._reported_lost:
+                    self._recorder.record(
+                        "host_lost", host=h,
+                        last_step=by_host.get(h, {}).get("last_step", -1),
+                    )
+        self._reported_lost = set(lost)
+        action = "re_mesh" if lost else ("restart" if stragglers else "none")
+        return {
+            "action": action,
+            "lost_hosts": lost,
+            "alive_hosts": alive,
+            "stragglers": stragglers,
+            "n_expected": len(expected),
+            "heartbeat_timeout_s": self.heartbeat_timeout_s,
+        }

@@ -58,6 +58,15 @@ EVENT_KINDS = (
     "engine_failure",
     "server_error",
     "ckpt_restore",
+    # -- training resilience (train/faultinject.py, train/resilience.py,
+    #    train/loop.py non-finite guard, obs/fleet.py FleetSupervisor) --
+    "fault_injected",    # a scheduled FaultPlan event fired (kind, step)
+    "nonfinite_loss",    # NaN/Inf step loss seen by the loop guard
+    "ckpt_save_error",   # periodic save attempt failed (absorbed)
+    "train_restart",     # transient failure -> restore + re-enter loop
+    "train_fatal",       # fatal classification: dumping and re-raising
+    "preempt_exit",      # SIGTERM/SIGINT -> final sync checkpoint + exit
+    "host_lost",         # FleetSupervisor: a host's beacon went stale
     "dump",
 )
 

@@ -199,6 +199,106 @@ def data_axes(mesh: Mesh) -> tuple[str, ...]:
     return tuple(a for a in ("replica", "data") if a in mesh.axis_names)
 
 
+@dataclasses.dataclass(frozen=True)
+class ElasticPlan:
+    """A dp/tp replan onto a surviving device set (see
+    :func:`plan_elastic_mesh`). ``axes`` feeds straight into
+    :func:`build_mesh` together with the surviving device list;
+    ``notes`` records every fallback taken, in order."""
+
+    axes: dict[str, int]
+    n_devices: int      # devices the plan actually uses (dp * tp)
+    dp: int
+    tp: int
+    grad_accum: int
+    global_batch: int
+    notes: tuple[str, ...] = ()
+
+
+def plan_elastic_mesh(
+    surviving: int | Sequence,
+    *,
+    tp: int = 1,
+    global_batch: int = 0,
+    grad_accum: int = 1,
+    old_dp: int = 0,
+) -> ElasticPlan:
+    """Replan dp/tp onto the devices that survived a host loss.
+
+    The elastic-resume recipe (docs/DEPLOY.md "Surviving a cluster"): when
+    the :class:`~distributed_tensorflow_tpu.obs.fleet.FleetSupervisor`
+    declares ``re_mesh``, the relaunch calls this with the surviving
+    device set (or count), builds ``build_mesh(plan.axes, devices)``, and
+    restores the sharded checkpoint straight into the new layout — orbax/
+    tensorstore reshards on read, so no migration step exists.
+
+    Degradation policy, mirroring ``serve.engine.plan_serve_mesh``: never
+    refuse a survivable topology, always log what was given up —
+
+    - ``tp`` that no longer divides the survivors falls back to its
+      largest divisor that does (worst case 1 = pure DP; params restore
+      into any tp width via the template machinery);
+    - ``dp`` shrinks to the largest width dividing ``global_batch``
+      (loaders require exact divisibility), idling the remainder — a
+      smaller mesh that trains beats a bigger one that cannot;
+    - ``grad_accum`` is rescaled by ``old_dp / new_dp`` (rounded up to a
+      divisor of the per-device rows) so the GLOBAL batch — and with it
+      the training trajectory's recipe — is preserved while the
+      per-microslice device memory stays bounded at the old level.
+    """
+    n = surviving if isinstance(surviving, int) else len(surviving)
+    if n < 1:
+        raise ValueError(f"need at least one surviving device, got {n}")
+    notes: list[str] = []
+    tp = max(int(tp), 1)
+    if tp > 1 and (tp > n or n % tp):
+        new_tp = max(d for d in range(1, min(tp, n) + 1) if tp % d == 0 and n % d == 0)
+        notes.append(
+            f"tp={tp} does not divide {n} surviving devices; falling back "
+            f"to tp={new_tp}"
+        )
+        tp = new_tp
+    dp = n // tp
+    if global_batch:
+        if global_batch % dp:
+            new_dp = max(d for d in range(1, dp + 1) if global_batch % d == 0)
+            notes.append(
+                f"global batch {global_batch} not divisible by dp={dp}; "
+                f"shrinking to dp={new_dp} (idling {(dp - new_dp) * tp} "
+                "surviving devices)"
+            )
+            dp = new_dp
+    ga = max(int(grad_accum), 1)
+    if old_dp and global_batch and old_dp != dp:
+        # Preserve the old per-microslice device rows: the activation
+        # memory the old layout was sized for.
+        scaled = ga * old_dp / dp
+        new_ga = max(int(-(-scaled // 1)), 1)  # ceil
+        per_dev = global_batch // dp
+        while per_dev % new_ga and new_ga < per_dev:
+            new_ga += 1
+        if new_ga != ga:
+            notes.append(
+                f"grad_accum {ga} -> {new_ga} (dp {old_dp} -> {dp}; global "
+                f"batch {global_batch} preserved)"
+            )
+            ga = new_ga
+    axes = {"data": dp}
+    if tp > 1:
+        axes["model"] = tp
+    for note in notes:
+        logger.warning("elastic re-mesh: %s", note)
+    return ElasticPlan(
+        axes=axes,
+        n_devices=dp * tp,
+        dp=dp,
+        tp=tp,
+        grad_accum=ga,
+        global_batch=global_batch,
+        notes=tuple(notes),
+    )
+
+
 # Short axis tags for layout labels, keyed by the canonical axis names.
 _AXIS_SHORT = {
     "replica": "rep",

@@ -14,4 +14,15 @@ from distributed_tensorflow_tpu.train.step import (  # noqa: F401
     make_rng,
     make_train_step,
 )
-from distributed_tensorflow_tpu.train.loop import fit  # noqa: F401
+from distributed_tensorflow_tpu.train.loop import NonFiniteLossError, fit  # noqa: F401
+from distributed_tensorflow_tpu.train.faultinject import (  # noqa: F401
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+)
+from distributed_tensorflow_tpu.train.resilience import (  # noqa: F401
+    ResilienceConfig,
+    ResilienceReport,
+    run_resilient,
+)

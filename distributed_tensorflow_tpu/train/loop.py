@@ -24,12 +24,14 @@ TPU-first details:
 from __future__ import annotations
 
 import logging
+import math
 import time
 from collections.abc import Callable, Iterable, Iterator
 from typing import Any
 
 import jax
 
+from distributed_tensorflow_tpu.obs.flightrec import NULL_RECORDER
 from distributed_tensorflow_tpu.obs.memory import default_registry
 from distributed_tensorflow_tpu.obs.metrics import FeedMetrics
 from distributed_tensorflow_tpu.obs.trace import NULL_TRACER, Tracer
@@ -38,6 +40,24 @@ logger = logging.getLogger(__name__)
 
 # hook(step: int, state, metrics: dict[str, float]) -> None, called at log cadence
 Hook = Callable[[int, Any, dict], None]
+
+
+class NonFiniteLossError(RuntimeError):
+    """The step loss went NaN/Inf — training state is garbage from here.
+
+    Raised by the loop's non-finite guard (``fit(nonfinite="abort")``, the
+    default). Deliberately NOT a transient failure class: restarting from
+    the last checkpoint would replay the same divergence, so
+    ``train/resilience.py`` classifies it fatal-with-dump.
+    """
+
+    def __init__(self, step: int, loss: float):
+        super().__init__(
+            f"non-finite loss {loss!r} at step {step}; aborting (use "
+            "--nonfinite=skip to tolerate)"
+        )
+        self.step = step
+        self.loss = loss
 
 
 def fit(
@@ -57,6 +77,10 @@ def fit(
     tracer: Tracer | None = None,
     timeline=None,
     memory=None,
+    recorder=None,
+    fault_injector=None,
+    nonfinite: str = "abort",
+    should_stop: Callable[[], bool] | None = None,
 ):
     """Run the training loop; returns the final state.
 
@@ -94,11 +118,32 @@ def fit(
     process-wide registry) receives the ``params`` / ``opt_state`` /
     ``grad_ring`` byte footprints once at loop entry — shape-derived, so
     the accounting never touches the step stream.
+
+    ``recorder`` (obs/flightrec.py) receives the loop's failure-path
+    events (``nonfinite_loss``); ``fault_injector``
+    (train/faultinject.py) is consulted once per step before dispatch —
+    both default to no-ops and cost nothing in the hot loop.
+
+    ``nonfinite`` is the NaN/Inf-loss policy, checked at the metrics
+    cadence (``log_every`` — the loop only ever blocks on device values
+    there, so the guard adds ZERO extra syncs; up to ``log_every - 1``
+    poisoned steps can run before detection): ``"abort"`` (default)
+    raises :class:`NonFiniteLossError`, ``"skip"`` records the event and
+    trains on.
+
+    ``should_stop`` is polled once per step; returning True ends the loop
+    cleanly with the current state (the preemption path —
+    ``train/resilience.py`` wires its SIGTERM/SIGINT flag here and then
+    writes the final synchronous checkpoint).
     """
     if rng is None:
         rng = jax.random.key(0)
     if tracer is None:
         tracer = NULL_TRACER
+    if recorder is None:
+        recorder = NULL_RECORDER
+    if nonfinite not in ("abort", "skip"):
+        raise ValueError(f"nonfinite must be 'abort' or 'skip', got {nonfinite!r}")
     # HBM accounting (obs/memory.py): shape-derived byte counts, no device
     # sync. ``memory`` defaults to the process-wide registry so a train
     # process's footprints show up anywhere /memz-style tooling looks.
@@ -118,6 +163,7 @@ def fit(
     start_step = int(state.step)
     if start_step >= num_steps:
         return state, None  # restored at (or past) the final step
+    poison_step = None  # injected nonfinite_loss pending detection
     t0 = time.perf_counter()  # run origin (only used if the run is 1 step)
     t_steady = None           # reset after the first step: excludes compile
     t_fetch = time.perf_counter()
@@ -125,10 +171,22 @@ def fit(
         batch = next(it)
     feed_metrics.observe_wait(time.perf_counter() - t_fetch)
     for step in range(start_step, num_steps):
+        if should_stop is not None and should_stop():
+            logger.info("stop requested before step %d; leaving the loop", step)
+            break
+        poison = (
+            fault_injector.on_step(step) if fault_injector is not None else False
+        )
         t_iter = time.perf_counter()
         wait_s = 0.0
         with tracer.span("dispatch", "train", step=step):
             state, metrics = train_step(state, batch, rng)
+        if poison and poison_step is None:
+            # Injected nonfinite_loss: poison the METRIC (what the guard
+            # watches), leaving the state untouched — the guard path is
+            # exercised without actually diverging the model. Sticky until
+            # the next metrics fetch, which is where the guard runs.
+            poison_step = step + 1
         dispatch_s = time.perf_counter() - t_iter
         if t_steady is None:
             # The first call paid tracing + compilation (dispatch itself is
@@ -152,6 +210,23 @@ def fit(
                 fetched_dev = jax.device_get(metrics)
             with tracer.span("metrics_fetch", "train", step=step + 1):
                 fetched = {k: float(v) for k, v in fetched_dev.items()}
+            if poison_step is not None and "loss" in fetched:
+                fetched["loss"] = float("nan")
+                poison_step = None
+            loss = fetched.get("loss")
+            if loss is not None and not math.isfinite(loss):
+                # str(), not the float: NaN/Inf are not valid JSON and the
+                # recorder's dump must stay strictly parseable.
+                recorder.record(
+                    "nonfinite_loss", step=step + 1, loss=str(loss),
+                    action=nonfinite,
+                )
+                if nonfinite == "abort":
+                    raise NonFiniteLossError(step + 1, loss)
+                logger.warning(
+                    "non-finite loss %r at step %d (nonfinite=skip: training on)",
+                    loss, step + 1,
+                )
             now = time.perf_counter()
             steps_done = step - start_step  # steady-state steps completed
             if steps_done > 0:

@@ -61,6 +61,13 @@ def main() -> int:
     )
     from distributed_tensorflow_tpu.train.step import place_state
 
+    if mode == "chaos":
+        # Like "straggler": beacons/checkpoints/dumps are the coordination-
+        # free channels under test, so no JAX cluster — each host trains on
+        # its own local mesh (the CPU backend can't form cross-process
+        # clusters on jax < 0.5 anyway).
+        return _chaos_body(proc_id, sys.argv[5])
+
     if mode == "straggler":
         # Beacons are collective-free by design — the processes share only
         # the beacon directory, never a JAX cluster — so this mode skips
@@ -201,6 +208,125 @@ def _straggler_body(proc_id: int, beacon_dir: str) -> int:
                 "median_step_s": summ["step_s"]["p50"],
                 "step": int(state.step),
                 "n_devices": len(jax.devices()),
+            }
+        )
+    )
+    return 0
+
+
+def _chaos_body(proc_id: int, workdir: str) -> int:
+    """ISSUE 15 chaos rehearsal: the sync-DP LeNet run through the REAL
+    resilience surfaces — flight recorder, fault injector, async periodic
+    checkpoints, health beacons — with process 0 carrying a seeded
+    FaultPlan that SIGKILLs it mid-step-11 (``host_drop``: the preemption
+    that never says goodbye). The injector force-dumps the flight recorder
+    before pulling the trigger, so the launcher can read the injected
+    events out of ``dumps_0`` even though the process died without atexit.
+
+    Layout under ``workdir``: ``beacons/`` (shared), ``ckpt_<proc>/``,
+    ``dumps_<proc>/``. Process 1 runs the same body fault-free to 16 and
+    exits 0 — the survivor whose fresh beacon the FleetSupervisor must
+    classify against the dead host's stale one.
+    """
+    import time
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_tensorflow_tpu.ckpt import Checkpointer
+    from distributed_tensorflow_tpu.data import (
+        device_batches,
+        synthetic_image_classification,
+    )
+    from distributed_tensorflow_tpu.models import LeNet5
+    from distributed_tensorflow_tpu.obs.fleet import HostBeacon, StepTimeline
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.train import (
+        create_train_state,
+        make_train_step,
+    )
+    from distributed_tensorflow_tpu.train.faultinject import (
+        FaultEvent,
+        FaultInjector,
+        FaultPlan,
+    )
+    from distributed_tensorflow_tpu.train.loop import fit
+    from distributed_tensorflow_tpu.train.objectives import (
+        init_model,
+        make_classification_loss,
+    )
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    work = Path(workdir)
+    mesh = build_mesh({"data": -1})
+    model = LeNet5()
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((1, 28, 28, 1), jnp.float32)
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    state = place_state(create_train_state(params, tx, model_state), mesh)
+    step = make_train_step(make_classification_loss(model), tx, mesh)
+
+    recorder = FlightRecorder(dump_dir=work / f"dumps_{proc_id}")
+    if proc_id == 0:
+        # slow_step at 5 proves a non-lethal injection lands in the same
+        # dump/beacon channels; host_drop at 11 is the kill. ckpt_every=4
+        # queues the async save at step 8 — the ~3 padded steps before
+        # death give the tiny write ample time to become durable, so the
+        # launcher's resume loses 11-8=3 <= ckpt_every steps.
+        plan = FaultPlan(
+            (
+                FaultEvent("slow_step", 5, duration_s=0.05),
+                FaultEvent("host_drop", 11),
+            )
+        )
+    else:
+        plan = FaultPlan(())
+    injector = FaultInjector(plan, recorder=recorder)
+
+    timeline = StepTimeline()
+    beacon = HostBeacon(
+        work / "beacons", proc_id, timeline, extras=injector.summary
+    )
+
+    def beacon_hook(step_no, state_, metrics_):
+        beacon.write()
+
+    def padded_step(state_, batch_, rng_):
+        # Real wall-clock per step so the async checkpoint writer gets
+        # scheduled between steps (and beacon wall_times order cleanly).
+        time.sleep(0.12)
+        return step(state_, batch_, rng_)
+
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+    batches = device_batches(ds, mesh, global_batch=32, seed=1)
+    with Checkpointer(work / f"ckpt_{proc_id}", fault_injector=injector) as ckpt:
+        state, _ = fit(
+            state,
+            padded_step,
+            batches,
+            num_steps=16,
+            rng=jax.random.key(0),
+            log_every=1,
+            hooks=(beacon_hook,),
+            checkpointer=ckpt,
+            ckpt_every=4,
+            timeline=timeline,
+            recorder=recorder,
+            fault_injector=injector,
+        )
+        ckpt.wait()
+        latest = ckpt.latest_step()
+    print(
+        json.dumps(
+            {
+                "proc": proc_id,
+                "step": int(state.step),
+                "latest_ckpt": latest,
+                "last_step": timeline.last_step,
             }
         )
     )

@@ -1,0 +1,652 @@
+"""Fault injection + preemption-safe training tests (ISSUE 15 tentpole).
+
+Per-fault-kind fixtures drive the REAL signal paths: feeder faults travel
+the prefetch _ERROR channel into run_resilient's restart loop, checkpoint
+write faults hit Checkpointer.save and are absorbed by the retrying
+wrapper, non-finite losses trip the loop guard on the fetched-metrics
+path, and SIGTERM lands a final synchronous checkpoint. The elastic
+re-mesh planner and the FleetSupervisor's restart-vs-re-mesh decisions
+are covered pure-python. Everything here is tier-1 fast; the end-to-end
+SIGKILL chaos run lives in tests/test_multiprocess.py.
+"""
+
+import json
+import os
+import signal
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_tpu.ckpt import Checkpointer
+from distributed_tensorflow_tpu.data import (
+    device_batches,
+    synthetic_image_classification,
+)
+from distributed_tensorflow_tpu.data.prefetch import prefetch
+from distributed_tensorflow_tpu.models import LeNet5
+from distributed_tensorflow_tpu.obs.fleet import FleetSupervisor
+from distributed_tensorflow_tpu.parallel.mesh import build_mesh, plan_elastic_mesh
+from distributed_tensorflow_tpu.train import (
+    NonFiniteLossError,
+    create_train_state,
+    fit,
+    make_train_step,
+)
+from distributed_tensorflow_tpu.train.faultinject import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+)
+from distributed_tensorflow_tpu.train.objectives import (
+    init_model,
+    make_classification_loss,
+)
+from distributed_tensorflow_tpu.train.resilience import (
+    CheckpointSaveError,
+    PreemptionHandler,
+    ResilienceConfig,
+    ResilientCheckpointer,
+    RestartBudgetExhausted,
+    classify_failure,
+    ckpt_save_errors_total,
+    run_resilient,
+    train_restarts_total,
+)
+
+
+class FakeRecorder:
+    """Flight-recorder stand-in: keeps the event stream, counts dumps."""
+
+    def __init__(self):
+        self.events = []
+        self.dumps = []
+
+    def record(self, kind, request_id=None, **detail):
+        self.events.append({"kind": kind, **detail})
+
+    def dump(self, reason, *, force=False):
+        self.dumps.append(reason)
+        return None
+
+    def kinds(self):
+        return [e["kind"] for e in self.events]
+
+
+@pytest.fixture(scope="module")
+def lenet(devices8):
+    """One compiled LeNet sync-DP step shared by every run in this module.
+
+    ``make_state`` places from HOST copies each call: the compiled step
+    donates the live state's buffers, so every run needs fresh arrays.
+    """
+    mesh = build_mesh({"data": -1})
+    model = LeNet5()
+    params, model_state = init_model(
+        model, jax.random.key(0), jnp.zeros((2, 28, 28, 1))
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    host_params = jax.device_get(params)
+    host_mstate = jax.device_get(model_state)
+    step = make_train_step(make_classification_loss(model), tx, mesh)
+
+    def make_state():
+        from distributed_tensorflow_tpu.train.step import place_state
+
+        return place_state(
+            create_train_state(host_params, tx, host_mstate), mesh
+        )
+
+    return SimpleNamespace(
+        mesh=mesh,
+        step=step,
+        make_state=make_state,
+        host_params=host_params,
+        host_mstate=host_mstate,
+        tx=tx,
+        loss=make_classification_loss(model),
+    )
+
+
+def _make_batches_factory(mesh, injector, *, seed=1, global_batch=64, depth=2):
+    """run_resilient's make_batches contract over the synthetic loader."""
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+
+    def make_batches(start_step):
+        src = device_batches(
+            ds, mesh, global_batch=global_batch, seed=seed, start_step=start_step
+        )
+        return prefetch(src, depth, fault_injector=injector)
+
+    return make_batches
+
+
+# ---- FaultPlan: determinism, parsing, one-shot semantics -----------------
+
+
+def test_fault_plan_generate_deterministic():
+    counts = {"feeder_error": 2, "slow_step": 1, "ckpt_write_error": 1}
+    a = FaultPlan.generate(7, 100, counts, slow_step_s=0.2)
+    b = FaultPlan.generate(7, 100, counts, slow_step_s=0.2)
+    assert a == b
+    assert len(a.events) == 4
+    assert all(1 <= e.step < 100 for e in a.events)
+    slow = [e for e in a.events if e.kind == "slow_step"]
+    assert slow and slow[0].duration_s == 0.2
+    # A different seed moves the schedule.
+    c = FaultPlan.generate(8, 100, counts, slow_step_s=0.2)
+    assert c != a
+
+
+def test_fault_plan_parse_spec_matches_generate():
+    spec = "seed=7,feeder_error=2,slow_step=1,slow_step_s=0.2,min_step=5"
+    parsed = FaultPlan.parse(spec, num_steps=100)
+    assert parsed == FaultPlan.generate(
+        7, 100, {"feeder_error": 2, "slow_step": 1}, slow_step_s=0.2, min_step=5
+    )
+    assert all(e.step >= 5 for e in parsed.events)
+
+
+def test_fault_plan_json_roundtrip(tmp_path):
+    plan = FaultPlan.generate(3, 50, {"feeder_error": 1, "host_drop": 1})
+    path = tmp_path / "plan.json"
+    path.write_text(plan.to_json())
+    assert FaultPlan.from_file(path) == plan
+    # parse() detects the file form by suffix/separator.
+    assert FaultPlan.parse(str(path)) == plan
+
+
+def test_fault_plan_parse_rejects_bad_specs():
+    with pytest.raises(ValueError, match="unknown --fault-plan key"):
+        FaultPlan.parse("seed=1,bogus_kind=2", num_steps=10)
+    with pytest.raises(ValueError, match="num_steps"):
+        FaultPlan.parse("seed=1,feeder_error=1")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent("bogus", 3)
+
+
+def test_injector_one_shot_with_duplicates():
+    """Two events, same kind, same step: fire once each, then never again."""
+    plan = FaultPlan(
+        (
+            FaultEvent("feeder_error", 4),
+            FaultEvent("feeder_error", 4),
+            FaultEvent("ckpt_write_error", 2),
+        )
+    )
+    rec = FakeRecorder()
+    inj = FaultInjector(plan, recorder=rec)
+    with pytest.raises(InjectedFault):
+        inj.check_feeder(4)
+    with pytest.raises(InjectedFault):
+        inj.check_feeder(4)
+    inj.check_feeder(4)  # multiset drained: no third firing
+    inj.check_feeder(3)  # unscheduled index: silent
+    with pytest.raises(InjectedFault) as exc_info:
+        inj.check_ckpt_save(2)
+    assert isinstance(exc_info.value, OSError)  # the transient class
+    assert classify_failure(exc_info.value) == "transient"
+    inj.check_ckpt_save(2)
+    assert rec.kinds() == ["fault_injected"] * 3
+    summ = inj.summary()
+    assert summ["injected_faults"] == {"feeder_error": 2, "ckpt_write_error": 1}
+    assert len(summ["recent_injected"]) == 3
+
+
+def test_injector_slow_step_sleeps_scheduled_duration():
+    slept = []
+    plan = FaultPlan((FaultEvent("slow_step", 3, duration_s=0.25),))
+    inj = FaultInjector(plan, sleep=slept.append)
+    assert inj.on_step(2) is False
+    assert inj.on_step(3) is False  # slow, but not poisoned
+    assert slept == [0.25]
+
+
+def test_classify_failure_table():
+    assert classify_failure(InjectedFault("feeder_error", 1)) == "transient"
+    assert classify_failure(ConnectionError("reset")) == "transient"
+    assert classify_failure(RuntimeError("prefetch feeder thread died")) == "transient"
+    assert classify_failure(NonFiniteLossError(1, float("nan"))) == "fatal"
+    assert classify_failure(CheckpointSaveError("budget")) == "fatal"
+    assert classify_failure(ValueError("shape mismatch")) == "fatal"
+
+
+# ---- run_resilient: the restart loop -------------------------------------
+
+
+def _fast_config(**kw):
+    kw.setdefault("sleep", lambda s: None)  # no real backoff in tests
+    return ResilienceConfig(**kw)
+
+
+def test_feeder_fault_restarts_and_completes(tmp_path, lenet):
+    """A feeder error mid-run: restore from the last async checkpoint,
+    rebuild the stream at the resume position, finish hands-off."""
+    rec = FakeRecorder()
+    inj = FaultInjector(
+        FaultPlan((FaultEvent("feeder_error", 3),)), recorder=rec
+    )
+    restarts_before = train_restarts_total.value
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        report = run_resilient(
+            lenet.make_state(),
+            lenet.step,
+            _make_batches_factory(lenet.mesh, inj),
+            num_steps=6,
+            checkpointer=ckpt,
+            ckpt_every=2,
+            config=_fast_config(),
+            recorder=rec,
+            fault_injector=inj,
+            rng=jax.random.key(0),
+            log_every=0,
+        )
+        assert ckpt.latest_step() == 6
+    assert report.completed and not report.preempted
+    assert report.final_step == 6
+    assert report.restarts == 1
+    assert report.failures == [
+        {"step": 0, "error": "InjectedFault", "kind": "transient"}
+    ]
+    assert train_restarts_total.value == restarts_before + 1
+    assert "fault_injected" in rec.kinds()
+    restart_events = [e for e in rec.events if e["kind"] == "train_restart"]
+    assert len(restart_events) == 1
+    assert restart_events[0]["resume_step"] >= 2  # resumed past a checkpoint
+
+
+def test_ckpt_write_fault_absorbed_by_retry(tmp_path, lenet):
+    """One-shot ckpt_write_error at a save cadence: the wrapper's
+    immediate retry succeeds, training never notices."""
+    rec = FakeRecorder()
+    inj = FaultInjector(
+        FaultPlan((FaultEvent("ckpt_write_error", 2),)), recorder=rec
+    )
+    errors_before = ckpt_save_errors_total.value
+    with Checkpointer(tmp_path / "ckpt", fault_injector=inj) as ckpt:
+        report = run_resilient(
+            lenet.make_state(),
+            lenet.step,
+            _make_batches_factory(lenet.mesh, inj),
+            num_steps=4,
+            checkpointer=ckpt,
+            ckpt_every=2,
+            config=_fast_config(),
+            recorder=rec,
+            fault_injector=inj,
+            rng=jax.random.key(0),
+            log_every=0,
+        )
+        assert ckpt.latest_step() == 4
+    assert report.completed and report.restarts == 0
+    assert ckpt_save_errors_total.value == errors_before + 1
+    save_errs = [e for e in rec.events if e["kind"] == "ckpt_save_error"]
+    assert len(save_errs) == 1 and save_errs[0]["step"] == 2
+
+
+def test_ckpt_cadence_failure_nonfatal(tmp_path, lenet):
+    """Both attempts of one cadence fail (duplicate events): the save is
+    skipped, training continues, the next cadence lands."""
+    rec = FakeRecorder()
+    inj = FaultInjector(
+        FaultPlan(
+            (FaultEvent("ckpt_write_error", 2), FaultEvent("ckpt_write_error", 2))
+        ),
+        recorder=rec,
+    )
+    with Checkpointer(tmp_path / "ckpt", fault_injector=inj) as ckpt:
+        report = run_resilient(
+            lenet.make_state(),
+            lenet.step,
+            _make_batches_factory(lenet.mesh, inj),
+            num_steps=4,
+            checkpointer=ckpt,
+            ckpt_every=2,
+            config=_fast_config(),
+            recorder=rec,
+            fault_injector=inj,
+            rng=jax.random.key(0),
+            log_every=0,
+        )
+        # Step-2 cadence lost, step-4 cadence (and final save) landed.
+        assert ckpt.latest_step() == 4
+    assert report.completed and report.restarts == 0
+    assert len([e for e in rec.events if e["kind"] == "ckpt_save_error"]) == 2
+
+
+def test_consecutive_ckpt_failures_fatal():
+    """max_consecutive failed CADENCES -> CheckpointSaveError (fatal)."""
+
+    class BrokenCheckpointer:
+        def save(self, step, state, *, force=False):
+            raise OSError("disk full")
+
+    rec = FakeRecorder()
+    rckpt = ResilientCheckpointer(
+        BrokenCheckpointer(), max_consecutive=3, recorder=rec
+    )
+    rckpt.save(2, None)  # cadence 1: absorbed
+    rckpt.save(4, None)  # cadence 2: absorbed
+    with pytest.raises(CheckpointSaveError):
+        rckpt.save(6, None)  # cadence 3: budget gone
+    # 2 attempts per cadence, every one recorded.
+    assert len([e for e in rec.events if e["kind"] == "ckpt_save_error"]) == 6
+    assert classify_failure(CheckpointSaveError("x")) == "fatal"
+
+
+def test_restart_budget_exhausted(lenet):
+    """A fault that never clears (no checkpoint -> no progress) burns the
+    consecutive budget and raises RestartBudgetExhausted."""
+    rec = FakeRecorder()
+    inj = FaultInjector(
+        FaultPlan(tuple(FaultEvent("feeder_error", 0) for _ in range(5))),
+        recorder=rec,
+    )
+    with pytest.raises(RestartBudgetExhausted):
+        run_resilient(
+            lenet.make_state(),
+            lenet.step,
+            _make_batches_factory(lenet.mesh, inj),
+            num_steps=6,
+            config=_fast_config(max_restarts=2),
+            recorder=rec,
+            fault_injector=inj,
+            make_state=lenet.make_state,  # no checkpointer: fresh-state restarts
+            rng=jax.random.key(0),
+            log_every=0,
+        )
+    kinds = rec.kinds()
+    assert kinds.count("train_restart") == 2  # the budget, then the raise
+    assert "train_fatal" in kinds
+    assert "train_fatal" in rec.dumps  # forced flight-recorder dump
+
+
+def test_progress_resets_restart_budget(tmp_path, lenet):
+    """Restarts that resume from NEWER checkpoints don't burn the budget:
+    2 distinct transient faults survive max_restarts=1."""
+    rec = FakeRecorder()
+    # Feed indices are per-wrapper-instance: index 2 fires in segment 1,
+    # then index 4 fires in the post-restart segment — two separate
+    # failures, each after fresh checkpoint progress.
+    inj = FaultInjector(
+        FaultPlan((FaultEvent("feeder_error", 2), FaultEvent("feeder_error", 4))),
+        recorder=rec,
+    )
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        report = run_resilient(
+            lenet.make_state(),
+            lenet.step,
+            _make_batches_factory(lenet.mesh, inj),
+            num_steps=10,
+            checkpointer=ckpt,
+            ckpt_every=1,
+            config=_fast_config(max_restarts=1),
+            recorder=rec,
+            fault_injector=inj,
+            rng=jax.random.key(0),
+            log_every=0,
+        )
+    assert report.completed
+    assert report.restarts == 2  # > max_restarts, legal because of progress
+
+
+def test_nonfinite_loss_abort(lenet):
+    """Poisoned loss at the metrics fetch -> NonFiniteLossError + event;
+    run_resilient classifies it fatal (replay would reproduce it)."""
+    rec = FakeRecorder()
+    inj = FaultInjector(FaultPlan((FaultEvent("nonfinite_loss", 2),)), recorder=rec)
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+    batches = device_batches(ds, lenet.mesh, global_batch=64, seed=1)
+    with pytest.raises(NonFiniteLossError) as exc_info:
+        fit(
+            lenet.make_state(),
+            lenet.step,
+            batches,
+            num_steps=5,
+            rng=jax.random.key(0),
+            log_every=1,
+            recorder=rec,
+            fault_injector=inj,
+        )
+    assert classify_failure(exc_info.value) == "fatal"
+    nf = [e for e in rec.events if e["kind"] == "nonfinite_loss"]
+    assert len(nf) == 1 and nf[0]["loss"] == "nan"
+
+
+def test_nonfinite_loss_skip_continues(lenet):
+    rec = FakeRecorder()
+    inj = FaultInjector(FaultPlan((FaultEvent("nonfinite_loss", 2),)), recorder=rec)
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+    batches = device_batches(ds, lenet.mesh, global_batch=64, seed=1)
+    state, metrics = fit(
+        lenet.make_state(),
+        lenet.step,
+        batches,
+        num_steps=5,
+        rng=jax.random.key(0),
+        log_every=1,
+        recorder=rec,
+        fault_injector=inj,
+        nonfinite="skip",
+    )
+    assert int(state.step) == 5
+    assert "nonfinite_loss" in rec.kinds()  # still observable, just non-fatal
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_fit_rejects_bad_nonfinite_policy(lenet):
+    with pytest.raises(ValueError, match="nonfinite"):
+        fit(
+            lenet.make_state(),
+            lenet.step,
+            iter(()),
+            num_steps=1,
+            nonfinite="explode",
+        )
+
+
+def test_preemption_sigterm_checkpoints_and_exits(tmp_path, lenet):
+    """SIGTERM mid-run: stop at the next step boundary, write a final
+    SYNCHRONOUS checkpoint, return preempted=True."""
+    rec = FakeRecorder()
+    prev_term = signal.getsignal(signal.SIGTERM)
+
+    def preempt_at_3(step, state, metrics):
+        if step == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        report = run_resilient(
+            lenet.make_state(),
+            lenet.step,
+            _make_batches_factory(lenet.mesh, None),
+            num_steps=50,
+            checkpointer=ckpt,
+            ckpt_every=0,  # no periodic saves: the final save is the proof
+            config=_fast_config(),
+            recorder=rec,
+            rng=jax.random.key(0),
+            log_every=1,
+            hooks=(preempt_at_3,),
+        )
+        assert ckpt.latest_step() == report.final_step
+    assert report.preempted and not report.completed
+    assert 3 <= report.final_step < 50
+    exits = [e for e in rec.events if e["kind"] == "preempt_exit"]
+    assert len(exits) == 1 and exits[0]["signum"] == signal.SIGTERM
+    # Previous signal disposition restored on the way out.
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+
+
+def test_preemption_handler_install_restore():
+    prev = signal.getsignal(signal.SIGTERM)
+    h = PreemptionHandler((signal.SIGTERM,)).install()
+    try:
+        assert not h.should_stop()
+        assert signal.getsignal(signal.SIGTERM) == h._handle
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.triggered and h.signum == signal.SIGTERM
+    finally:
+        h.restore()
+    assert signal.getsignal(signal.SIGTERM) is prev
+    h.restore()  # idempotent
+
+
+# ---- elastic re-mesh ------------------------------------------------------
+
+
+def test_plan_elastic_mesh_preserves_global_batch():
+    """8 -> 4 devices: dp halves, grad_accum doubles, recipe preserved."""
+    plan = plan_elastic_mesh(4, global_batch=256, grad_accum=1, old_dp=8)
+    assert plan.axes == {"data": 4}
+    assert (plan.dp, plan.tp, plan.grad_accum) == (4, 1, 2)
+    # Per-microslice rows unchanged: 256/8/1 == 256/4/2.
+    assert 256 // 8 // 1 == 256 // plan.dp // plan.grad_accum
+    assert any("grad_accum 1 -> 2" in n for n in plan.notes)
+
+
+def test_plan_elastic_mesh_tp_fallback():
+    """tp=4 can't divide 6 survivors: fall back to tp=2, dp=3."""
+    plan = plan_elastic_mesh(6, tp=4, global_batch=48)
+    assert (plan.tp, plan.dp) == (2, 3)
+    assert plan.axes == {"data": 3, "model": 2}
+    assert plan.n_devices == 6
+    assert any("falling back to tp=2" in n for n in plan.notes)
+
+
+def test_plan_elastic_mesh_idles_indivisible_remainder():
+    """dp that doesn't divide the global batch shrinks (idling devices)
+    rather than refusing to plan."""
+    plan = plan_elastic_mesh(7, global_batch=64)
+    assert plan.dp == 4 and plan.n_devices == 4  # 64 % 7 != 0 -> idle 3
+    assert any("idling" in n for n in plan.notes)
+    with pytest.raises(ValueError, match="surviving"):
+        plan_elastic_mesh(0)
+
+
+def test_elastic_restore_into_smaller_mesh(tmp_path, lenet, devices8):
+    """The full elastic-resume recipe: checkpoint on 8 devices, replan to
+    the 4 survivors, restore straight into the new layout, keep training."""
+    from distributed_tensorflow_tpu.train.step import place_state
+
+    ds = synthetic_image_classification(256, (28, 28, 1), 10, seed=0)
+    state = lenet.make_state()
+    batches = device_batches(ds, lenet.mesh, global_batch=64, seed=1)
+    rng = jax.random.key(0)
+    for _ in range(3):
+        state, _ = lenet.step(state, next(batches), rng)
+    saved_params = jax.device_get(state.params)
+
+    with Checkpointer(tmp_path / "ckpt") as ckpt:
+        ckpt.save(3, state)
+        ckpt.wait()
+
+        plan = plan_elastic_mesh(4, global_batch=64, grad_accum=1, old_dp=8)
+        mesh4 = build_mesh(plan.axes, devices=devices8[:4])
+        fresh = place_state(
+            create_train_state(lenet.host_params, lenet.tx, lenet.host_mstate),
+            mesh4,
+        )
+        restored, start = ckpt.restore_latest(fresh)
+
+    assert start == 3 and int(restored.step) == 3
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(restored.params),
+        saved_params,
+    )
+    # The restored state trains on the survivors' mesh.
+    step4 = make_train_step(lenet.loss, lenet.tx, mesh4)
+    batches4 = device_batches(
+        ds, mesh4, global_batch=64, seed=1, start_step=start
+    )
+    restored, metrics = step4(restored, next(batches4), rng)
+    assert int(restored.step) == 4
+    assert np.isfinite(float(metrics["loss"]))
+
+
+# ---- FleetSupervisor: restart-vs-re-mesh ----------------------------------
+
+
+def _write_beacon(d, host, wall_time, p50=0.1, last_step=10):
+    (d / f"host_{host}.json").write_text(
+        json.dumps(
+            {
+                "host": host,
+                "wall_time": wall_time,
+                "last_step": last_step,
+                "step_s": {"p50": p50, "count": 5},
+            }
+        )
+    )
+
+
+def test_fleet_supervisor_healthy_fleet(tmp_path):
+    _write_beacon(tmp_path, 0, 99.0)
+    _write_beacon(tmp_path, 1, 99.5)
+    sup = FleetSupervisor(
+        tmp_path, expected_hosts=2, heartbeat_timeout_s=5.0, clock=lambda: 100.0
+    )
+    verdict = sup.poll()
+    assert verdict["action"] == "none"
+    assert verdict["alive_hosts"] == [0, 1] and not verdict["lost_hosts"]
+
+
+def test_fleet_supervisor_stale_beacon_is_lost(tmp_path):
+    rec = FakeRecorder()
+    _write_beacon(tmp_path, 0, 90.0)  # age 10 > timeout 5
+    _write_beacon(tmp_path, 1, 99.0)
+    sup = FleetSupervisor(
+        tmp_path,
+        expected_hosts=2,
+        heartbeat_timeout_s=5.0,
+        clock=lambda: 100.0,
+        recorder=rec,
+    )
+    verdict = sup.poll()
+    assert verdict["action"] == "re_mesh"
+    assert verdict["lost_hosts"] == [0] and verdict["alive_hosts"] == [1]
+    sup.poll()  # second poll: same loss, no duplicate event
+    assert rec.kinds().count("host_lost") == 1
+
+
+def test_fleet_supervisor_missing_beacon_is_lost(tmp_path):
+    _write_beacon(tmp_path, 0, 99.0)
+    sup = FleetSupervisor(
+        tmp_path, expected_hosts=3, heartbeat_timeout_s=5.0, clock=lambda: 100.0
+    )
+    verdict = sup.poll()
+    assert verdict["action"] == "re_mesh"
+    assert verdict["lost_hosts"] == [1, 2]
+    assert verdict["n_expected"] == 3
+
+
+def test_fleet_supervisor_straggler_means_restart(tmp_path):
+    _write_beacon(tmp_path, 0, 99.0, p50=0.5)  # 5x the peer
+    _write_beacon(tmp_path, 1, 99.0, p50=0.1)
+    sup = FleetSupervisor(
+        tmp_path, expected_hosts=2, heartbeat_timeout_s=5.0, clock=lambda: 100.0
+    )
+    verdict = sup.poll()
+    assert verdict["action"] == "restart"
+    assert verdict["stragglers"] == [0] and not verdict["lost_hosts"]
+
+
+def test_fleet_supervisor_expects_every_seen_host(tmp_path):
+    """Without expected_hosts, a host that appeared once and went stale
+    still counts as lost."""
+    _write_beacon(tmp_path, 0, 99.0)
+    _write_beacon(tmp_path, 1, 99.0)
+    clock = {"t": 100.0}
+    sup = FleetSupervisor(
+        tmp_path, heartbeat_timeout_s=5.0, clock=lambda: clock["t"]
+    )
+    assert sup.poll()["action"] == "none"
+    (tmp_path / "host_1.json").unlink()
+    clock["t"] = 101.0
+    verdict = sup.poll()
+    assert verdict["action"] == "re_mesh" and verdict["lost_hosts"] == [1]
