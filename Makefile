@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick chaos-quick
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick chaos-quick fleet-quick
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -41,6 +41,18 @@ chaos-quick:
 	$(PY) -m pytest tests/test_resilience.py -q
 	$(PY) -m pytest tests/test_multiprocess.py::test_two_process_chaos_sigkill_resume \
 	    -q -m slow
+
+# Survive-the-fleet gate (~3 min): router unit tests (p2c/affinity math,
+# failover bounds, restart budget, race soak), the 2-process kill and
+# hot-swap integration tests, then the 3-replica serve_bench --fleet
+# chaos drill — seeded FaultPlan SIGKILLs one replica mid-trace (zero
+# dropped non-shed requests, restart within budget) and a rolling
+# checkpoint hot-swap under traffic ends with every replica on the new
+# tag (best-of-3 on timing gates; correctness unconditional).
+fleet-quick:
+	$(PY) -m pytest tests/test_router.py -q
+	$(PY) -m pytest tests/test_router.py -q -m slow
+	$(PY) scripts/serve_bench.py --fleet --quick
 
 # Static analysis + config sweep over the package; nonzero exit on any
 # non-baselined finding or stale baseline entry.

@@ -228,6 +228,10 @@ def build_serving_client(cfg, args):
         else "continuous",
         recorder=recorder,
         warmup_ready_fraction=getattr(args, "warmup_ready_fraction", 1.0),
+        # Deployment identity for the router's hot-swap verification:
+        # defaults to the restored step so a rolled checkpoint is visible
+        # on /healthz without any operator input.
+        tag=getattr(args, "tag", None) or f"ckpt-{step}",
     )
     return client, make_payload
 
@@ -253,6 +257,10 @@ def main(argv: list[str] | None = None):
     parser.add_argument("--ckpt-dir", required=True,
                         help="training checkpoint directory (newest step served)")
     parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--tag", default=None,
+                        help="deployment tag surfaced on /healthz (default "
+                             "ckpt-<restored step>); the router's hot-swap "
+                             "drill asserts it after a rolling restart")
     parser.add_argument("--port", type=int, default=8000,
                         help="0 = ephemeral (logged at startup)")
     parser.add_argument("--buckets", type=int, nargs="+",

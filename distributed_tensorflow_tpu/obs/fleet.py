@@ -387,3 +387,96 @@ class FleetSupervisor:
             "n_expected": len(expected),
             "heartbeat_timeout_s": self.heartbeat_timeout_s,
         }
+
+
+class ReplicaSupervisor:
+    """Per-replica poll-history verdicts for the serving router.
+
+    The serving analogue of :class:`FleetSupervisor`, with the restart
+    budget of ``train.resilience`` (which this module must not import —
+    it pulls in jax at module scope; the router stays stdlib-only).  The
+    semantics are the same on purpose:
+
+    - **progress-aware budget**: a replica that comes back *ready* after
+      a restart resets its consecutive-restart count, exactly as a
+      training restart that advances ``resume_step`` does — only
+      back-to-back failures with no intervening ready burn the budget;
+    - **exponential backoff**: restart *n* waits
+      ``min(base * factor**(n-1), cap)`` seconds, matching
+      ``ResilienceConfig.backoff_s``.
+
+    Threadless and poll-based like everything else here: the router's
+    poll loop feeds :meth:`record_poll` / :meth:`record_ready` /
+    :meth:`record_restart` and reads :meth:`verdict`:
+
+    - fewer than ``fail_threshold`` consecutive failed polls →
+      ``"none"`` (one dropped poll on a busy box must not bounce a
+      healthy replica);
+    - threshold reached with restart budget remaining → ``"restart"``;
+    - budget exhausted → ``"quarantine"`` — the replica is left down and
+      the fleet routes around it (restarting a replica that dies
+      instantly N times just feeds it traffic to drop).
+    """
+
+    def __init__(
+        self,
+        *,
+        fail_threshold: int = 3,
+        max_restarts: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_factor: float = 2.0,
+        backoff_max_s: float = 30.0,
+    ):
+        if fail_threshold < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        self.fail_threshold = int(fail_threshold)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_factor = float(backoff_factor)
+        self.backoff_max_s = float(backoff_max_s)
+        self._consecutive_fails = 0
+        self._consecutive_restarts = 0
+        self._total_restarts = 0
+        self._ready_since_restart = False
+
+    def record_poll(self, ok: bool) -> None:
+        """One health-poll outcome (True = got a well-formed response)."""
+        self._consecutive_fails = 0 if ok else self._consecutive_fails + 1
+
+    def record_ready(self) -> None:
+        """The replica reached *ready*: progress. Resets the consecutive
+        restart count so the budget only bounds back-to-back failures."""
+        self._consecutive_restarts = 0
+        self._ready_since_restart = True
+
+    def record_restart(self) -> float:
+        """Account one restart; returns the backoff to wait before it."""
+        self._consecutive_restarts += 1
+        self._total_restarts += 1
+        self._consecutive_fails = 0
+        self._ready_since_restart = False
+        n = self._consecutive_restarts
+        return min(
+            self.backoff_base_s * self.backoff_factor ** max(n - 1, 0),
+            self.backoff_max_s,
+        )
+
+    def verdict(self) -> str:
+        """``"none"`` / ``"restart"`` / ``"quarantine"`` for this poll."""
+        if self._consecutive_fails < self.fail_threshold:
+            return "none"
+        if self._consecutive_restarts >= self.max_restarts:
+            return "quarantine"
+        return "restart"
+
+    def summary(self) -> dict:
+        return {
+            "consecutive_fails": self._consecutive_fails,
+            "consecutive_restarts": self._consecutive_restarts,
+            "total_restarts": self._total_restarts,
+            "ready_since_restart": self._ready_since_restart,
+            "max_restarts": self.max_restarts,
+            "verdict": self.verdict(),
+        }

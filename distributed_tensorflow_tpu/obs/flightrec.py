@@ -67,6 +67,11 @@ EVENT_KINDS = (
     "train_fatal",       # fatal classification: dumping and re-raising
     "preempt_exit",      # SIGTERM/SIGINT -> final sync checkpoint + exit
     "host_lost",         # FleetSupervisor: a host's beacon went stale
+    # -- serving fleet (serve/router.py, obs/fleet.py ReplicaSupervisor) --
+    "router_spawn",      # router spawned/adopted a replica process
+    "replica_lost",      # health-poll timeout / refusal / process exit
+    "replica_restart",   # ReplicaSupervisor verdict -> replica relaunched
+    "hot_swap",          # rolling checkpoint swap step (drain/restart/done)
     "dump",
 )
 

@@ -49,4 +49,8 @@ from distributed_tensorflow_tpu.serve.spec import (  # noqa: F401
     NGramDrafter,
     SpecConfig,
 )
-from distributed_tensorflow_tpu.serve.server import Client, build_http_server  # noqa: F401
+from distributed_tensorflow_tpu.serve.server import (  # noqa: F401
+    Client,
+    Draining,
+    build_http_server,
+)

@@ -101,6 +101,9 @@ class DynamicBatcher:
 
     # Shared mutable state watched by obs.sanitizer.sanitize_races in the
     # pipelining tests; every access must be ordered by self._cv.
+    # _served is deliberately NOT watched: it is ordered by _cv like the
+    # rest, but instrumenting a per-flush hot-path write would eat into
+    # the racetrace overhead budget for zero extra race coverage.
     _RACETRACE_ATTRS = ("_queues", "_count", "_closed", "_n_inflight")
 
     def __init__(
@@ -132,6 +135,7 @@ class DynamicBatcher:
         self._cv = threading.Condition()
         self._queues: dict = {}      # bucket key -> deque[_Pending]
         self._count = 0              # total pending across buckets
+        self._served = 0             # lifetime completed requests
         self._closed = False
         self._inflight_sem = threading.BoundedSemaphore(
             self.config.max_in_flight
@@ -216,6 +220,7 @@ class DynamicBatcher:
             return {
                 "closed": self._closed,
                 "mode": "flush",
+                "served": self._served,
                 "queue_depth": self._count,
                 "max_queue": self.config.max_queue,
                 "in_flight": self._n_inflight,
@@ -384,6 +389,8 @@ class DynamicBatcher:
             if not p.future.cancelled():
                 p.future.phases = phases
                 p.future.set_result(r)
+        with self._cv:
+            self._served += len(batch)
         if self.recorder.enabled:
             for p in batch:
                 self.recorder.record(
@@ -691,6 +698,7 @@ class ContinuousBatcher:
         self._cv = threading.Condition()
         self._queue: deque[_Pending] = deque()
         self._count = 0
+        self._served = 0             # lifetime completed requests
         self._closed = False
         self._slots: list[_Slot | None] = [None] * engine.slots
         self._n_active = 0
@@ -760,6 +768,7 @@ class ContinuousBatcher:
             out = {
                 "closed": self._closed,
                 "mode": self._admission,
+                "served": self._served,
                 "queue_depth": self._count,
                 "max_queue": self.config.max_queue,
                 "in_flight": self._n_inflight,
@@ -1319,6 +1328,8 @@ class ContinuousBatcher:
                     "prompt_len": s.prompt_len,
                     "bucket": self._engine.bucket_for(s.prompt_len),
                 })
+        with self._cv:
+            self._served += len(finished)
         if self.recorder.enabled:
             for s in finished:
                 self.recorder.record("slot_free", s.pending.request_id,

@@ -50,13 +50,14 @@ spans and comes back in the response — success bodies also carry
 (``queue_wait/batch_assemble/dispatch/device/fetch``, milliseconds).
 
 Error mapping: RequestError -> 400; Backpressure -> 429 + ``Retry-After``;
-anything the engine raises mid-batch -> 500. All error bodies carry the
-``request_id``, so shed or failed load is attributable in client logs and
-server traces alike.
+:class:`Draining` (submit during drain) -> 503; anything the engine raises
+mid-batch -> 500. All error bodies carry the ``request_id``, so shed or
+failed load is attributable in client logs and server traces alike.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 from concurrent.futures import Future
@@ -86,6 +87,19 @@ from distributed_tensorflow_tpu.serve.engine import RequestError
 logger = logging.getLogger(__name__)
 
 
+class Draining(Exception):
+    """A submit arrived while the stack was draining (or closed): new work
+    is shed AT THE DOOR with an attributable ``request_id`` — it must not
+    enqueue behind work the drain is waiting out, and it must never hang.
+    The HTTP layer maps this to 503 (the drain contract: same code the
+    router already sees from ``/healthz``)."""
+
+    def __init__(self, request_id: str, state: str = "draining"):
+        super().__init__(f"shedding: server is {state}")
+        self.request_id = request_id
+        self.state = state
+
+
 class Client:
     """In-process serving client: ``submit`` returns a Future, ``call``
     blocks for the result. Payloads validate BEFORE they enqueue so a
@@ -108,8 +122,15 @@ class Client:
         recorder=None,
         memory=None,
         warmup_ready_fraction: float = 1.0,
+        tag: str | None = None,
     ):
         self.engine = engine
+        # Deployment identity (cli/serve.py sets "ckpt-<step>" from the
+        # restored checkpoint): surfaced on /healthz so the router's
+        # rolling hot-swap can VERIFY each replica came back on the new
+        # checkpoint instead of trusting the restart.
+        self.tag = tag
+        self._shed_ids = itertools.count()
         self.recorder = recorder if recorder is not None else NULL_RECORDER
         # The memory registry /memz answers from: an injected one, the
         # engine's (real engines register their footprints with the
@@ -222,6 +243,19 @@ class Client:
         }
 
     def submit(self, payload: dict, request_id: str | None = None) -> Future:
+        state = self.health.lifecycle
+        if state in ("draining", "closed"):
+            # Shed at the door, BEFORE validation or enqueue: a drain must
+            # finish the work it already owns, not accept more. The check
+            # races benignly with a concurrent drain flip — a request that
+            # slips past still completes under the drain contract.
+            rid = request_id or f"shed-{next(self._shed_ids):06d}"
+            self.metrics.rejected_by_cause.inc(state)
+            self.tracer.instant(
+                "rejected", "serve", request_id=rid, cause=state,
+            )
+            self.recorder.record("request_reject", rid, cause=state)
+            raise Draining(rid, state)
         try:
             self.engine.validate(payload)  # RequestError before enqueue
         except RequestError:
@@ -323,6 +357,7 @@ def build_http_server(
             mesh_info = getattr(client.engine, "mesh_info", None)
             return {
                 "engine": type(client.engine).__name__,
+                "tag": client.tag,
                 # Mesh topology digest: layout label, axis sizes, devices
                 # one batch spans (None for stub engines without a mesh).
                 "mesh": mesh_info() if callable(mesh_info) else None,
@@ -355,6 +390,7 @@ def build_http_server(
             if url.path == "/healthz":
                 code, body = client.health.probe()
                 body["engine"] = type(client.engine).__name__
+                body["tag"] = client.tag
                 self._reply(code, body)
             elif url.path == "/metrics":
                 q = parse_qs(url.query)
@@ -462,6 +498,18 @@ def build_http_server(
                 result = fut.result(timeout=60.0)
             except RequestError as e:
                 self._reply(400, {"error": str(e), "request_id": rid})
+            except Draining as e:
+                # Mid-drain submit: shed, never hang — the 503 carries the
+                # request_id and the state so the router can retry it on a
+                # survivor (drain-hardening satellite).
+                self._reply(
+                    503,
+                    {
+                        "error": str(e),
+                        "request_id": e.request_id,
+                        "status": e.state,
+                    },
+                )
             except json.JSONDecodeError as e:
                 self._reply(
                     400, {"error": f"bad JSON: {e}", "request_id": rid}

@@ -84,6 +84,29 @@ adversarial floor: spec-on tokens/s >= 0.9x spec-off on the random
 workload (backoff must make speculation nearly free when it can't win).
 The repetitive-workload speedup is recorded in docs/PERF.md round 14
 from full runs, not gated in CI (dispatch jitter at CI size).
+
+Fleet mode (--fleet, round 16) replaces the load sweep with the
+replicated-router chaos drill: the bench spawns N REAL replica server
+processes (this same script re-entered with ``--replica-serve PORT``,
+each a tiny causal-LM engine with the prefix cache on), fronts them with
+``serve.router.Router``, and drives a bursty Zipf shared-head traffic
+trace through the door. Mid-trace a seeded ``FaultPlan`` (``host_drop``)
+SIGKILLs one replica — the router must fail the in-flight requests over
+to survivors and restart the victim within its progress-aware budget —
+and after the trace a rolling checkpoint hot-swap (tag v1 -> v2) runs
+under continuing traffic. ``--quick`` is the CI gate (make fleet-quick):
+ZERO failed non-shed requests across kill and swap, victim restarted
+within ``--restart-budget-s``, every replica on the new tag, p99 latency
+bounded — best-of-3 on the timing gates (loadavg/core printed on
+retries), correctness accumulated unconditionally across every attempt.
+Full runs add the prefix-affinity A/B: the same trace through an
+affinity-routed fleet vs a load-only spray fleet, with the per-replica
+KV pool sized so ONE replica can hold ONE hot head — affinity partitions
+the fleet-wide cache (hits), spray thrashes it (evictions) — and the
+TTFT p50 ratio is recorded in docs/PERF.md round 16.
+
+    python scripts/serve_bench.py --fleet --quick   # CI chaos gate
+    python scripts/serve_bench.py --fleet           # + affinity A/B
 """
 
 from __future__ import annotations
@@ -1294,6 +1317,597 @@ def _run_recorder_ab(args) -> dict:
     }
 
 
+# ------------------------------------------------------------ fleet mode
+
+
+def _fleet_geo(quick: bool) -> dict:
+    """Replica geometry, shared by the parent (trace shape) and the
+    re-entered replica process (engine shape) so both derive it from the
+    one ``--quick`` flag instead of a dozen forwarded knobs.
+
+    The KV pool is deliberately sized to hold roughly ONE hot head per
+    replica: that is the regime where prefix affinity IS the fleet-wide
+    cache policy — affinity partitions the heads across replicas (every
+    replica serves its head from cache), spray rotates all heads through
+    every too-small pool (evictions, cold prefills)."""
+    if quick:
+        return dict(hidden=32, layers=2, heads=2, maxpos=48,
+                    buckets=(8, 32), slots=4, max_batch=2,
+                    head_len=24, tails=(3, 8), max_new=6, long_new=8,
+                    mb=0.25, bt=4, chunk=16, max_new_tokens=10,
+                    rps_hi=10.0, rps_lo=3.0)
+    return dict(hidden=128, layers=4, heads=4, maxpos=384,
+                buckets=(32, 256), slots=4, max_batch=2,
+                head_len=192, tails=(3, 16), max_new=6, long_new=10,
+                mb=1.0, bt=16, chunk=64, max_new_tokens=12,
+                rps_hi=8.0, rps_lo=2.0)
+
+
+def run_fleet_replica(args) -> int:
+    """Re-entered child process: one real replica server of the fleet.
+
+    Same stack a production replica runs — tiny causal-LM engine with
+    the prefix cache + chunked prefill on, continuous batcher, the
+    serve/server.py HTTP face — so the router is exercised against real
+    drain semantics and real (AOT-warmed) readiness, not a stub."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        CausalLMEngine,
+        Client,
+    )
+    from distributed_tensorflow_tpu.serve.server import build_http_server
+
+    geo = _fleet_geo(args.quick)
+    cfg = CausalLMConfig(
+        vocab_size=64, hidden_size=geo["hidden"],
+        num_layers=geo["layers"], num_heads=geo["heads"],
+        intermediate_size=4 * geo["hidden"], max_position=geo["maxpos"],
+    )
+    model = CausalLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+        jnp.ones((1, 8), bool),
+    )["params"]
+    engine = CausalLMEngine(
+        model, params, buckets=geo["buckets"], slots=geo["slots"],
+        max_batch=geo["max_batch"], max_new_tokens=geo["max_new_tokens"],
+        prefix_cache_mb=geo["mb"], block_tokens=geo["bt"],
+        prefill_chunk=geo["chunk"],
+    )
+    client = Client(
+        engine,
+        BatcherConfig(max_batch=geo["max_batch"], max_queue=256,
+                      max_in_flight=2),
+        tag=args.replica_tag,
+    )
+    server = build_http_server(client, port=args.replica_serve)
+    print(f"READY {server.server_address[1]}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        client.close()
+    return 0
+
+
+def make_fleet_trace(n: int, geo: dict, seed: int):
+    """(payloads, arrival_gaps): Zipf shared-head prompts with a
+    heavy-tailed output mix (every 8th request gets the long budget) and
+    bursty Poisson arrivals alternating a high-rate burst regime with a
+    low-rate lull every 12 requests — the diurnal-burst shape a fleet
+    door actually absorbs, compressed to bench scale."""
+    payloads = make_prefix_payloads(
+        n, heads=3, head_len=geo["head_len"], tail_lens=geo["tails"],
+        max_new=geo["max_new"], vocab=64, seed=seed,
+    )
+    for i, p in enumerate(payloads):
+        # These go over HTTP, not in-process: plain JSON types only.
+        p["input_ids"] = [int(t) for t in p["input_ids"]]
+        if i % 8 == 7:
+            p["max_new_tokens"] = geo["long_new"]
+    rng = np.random.default_rng(seed + 1)
+    gaps = [
+        float(rng.exponential(
+            1.0 / (geo["rps_hi"] if (i // 12) % 2 == 0 else geo["rps_lo"])
+        ))
+        for i in range(n)
+    ]
+    return payloads, gaps
+
+
+def _free_ports(n: int) -> list[int]:
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn_fleet(args, *, affinity_tokens: int, tag: str = "fleet-v1"):
+    """Router + N owned replica processes (this script re-entered),
+    waited until every replica is routable. Returns (router, ports)."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.serve.router import (
+        Router,
+        RouterConfig,
+    )
+
+    me = os.path.abspath(__file__)
+
+    def cmd_for(port: int, t: str) -> list[str]:
+        c = [sys.executable, me, "--fleet", "--replica-serve", str(port),
+             "--replica-tag", t]
+        if args.quick:
+            c.append("--quick")
+        return c
+
+    ports = _free_ports(args.fleet_replicas)
+    specs = [
+        (f"fleet-{i}", f"http://127.0.0.1:{p}", cmd_for(p, tag))
+        for i, p in enumerate(ports)
+    ]
+    router = Router(
+        specs,
+        RouterConfig(
+            poll_interval_s=0.2,
+            poll_timeout_s=2.0,
+            start_grace_s=300.0,
+            fail_threshold=2,
+            max_restarts=3,
+            backoff_base_s=0.5,
+            max_retries=2,
+            request_timeout_s=120.0,
+            affinity_tokens=affinity_tokens,
+            affinity_max_imbalance=8.0,
+            max_in_flight_per_replica=64,
+            ready_timeout_s=300.0,
+            drain_timeout_s=60.0,
+            seed=args.fleet_seed,
+        ),
+        recorder=FlightRecorder(capacity=2048),
+    )
+    router.start()
+    if not router.wait_ready(timeout=300.0):
+        router.close()
+        raise RuntimeError(
+            "fleet did not come up: "
+            + ", ".join(f"{r.name}={r.state}" for r in router.replicas)
+        )
+    return router, ports
+
+
+def _drive_fleet_trace(router, payloads, gaps, *, kill_at: int = -1,
+                       workers: int = 8):
+    """Open-loop trace drive: a dispatcher walks the arrival schedule
+    (never waiting on replies) and hands each request to a worker pool
+    calling ``router.route``. At request index ``kill_at`` (when >= 0)
+    the busiest replica is SIGKILLed — between two submits, exactly
+    where a real host loss lands. Returns ``(rows, victim_or_None)``."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    results: list[dict | None] = [None] * len(payloads)
+    victim = None
+
+    def one(i: int, payload: dict) -> None:
+        t0 = time.monotonic()
+        code, body = router.route("/v1/generate", dict(payload))
+        wall_ms = (time.monotonic() - t0) * 1e3
+        phases = body.get("phases") or {}
+        ttft = phases.get("queue_wait", 0.0) + phases.get("prefill", 0.0)
+        results[i] = {
+            "code": code,
+            "wall_ms": wall_ms,
+            "ttft_ms": ttft if phases else None,
+            "replica": body.get("replica"),
+            "shed": bool(body.get("shed")) or code == 429,
+            "ok": code == 200 and isinstance(body.get("tokens"), list),
+        }
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futs = []
+        for i, (p, gap) in enumerate(zip(payloads, gaps)):
+            if i == kill_at:
+                victim = _kill_busiest(router)
+            futs.append(pool.submit(one, i, p))
+            time.sleep(gap)
+        for f in futs:
+            f.result()
+    return [r for r in results if r is not None], victim
+
+
+def _kill_busiest(router):
+    """SIGKILL the replica carrying the most traffic — killing an idle
+    replica would not sever a single in-flight request, and the whole
+    point of the drill is that admitted work survives a host loss."""
+    import signal
+
+    live = [
+        r for r in router.replicas
+        if r.proc is not None and r.proc.poll() is None
+    ]
+    victim = max(live, key=lambda r: r.requests)
+    print(f"# chaos: SIGKILL {victim.name} (pid {victim.proc.pid}, "
+          f"{victim.requests} requests routed, "
+          f"{victim.in_flight} in flight)")
+    victim.proc.send_signal(signal.SIGKILL)
+    return victim
+
+
+def _fleet_stats(rows: list[dict]) -> dict:
+    ok = [r for r in rows if r["ok"]]
+    ttfts = sorted(r["ttft_ms"] for r in ok if r["ttft_ms"] is not None)
+    walls = sorted(r["wall_ms"] for r in ok)
+
+    def pct(xs, q):
+        return xs[min(int(q * len(xs)), len(xs) - 1)] if xs else 0.0
+
+    per_replica: dict[str, int] = {}
+    for r in ok:
+        per_replica[r["replica"]] = per_replica.get(r["replica"], 0) + 1
+    return {
+        "requests": len(rows),
+        "ok": len(ok),
+        "shed": sum(1 for r in rows if r["shed"]),
+        "failed": sum(1 for r in rows if not r["ok"] and not r["shed"]),
+        "ttft_p50_ms": pct(ttfts, 0.50),
+        "ttft_p99_ms": pct(ttfts, 0.99),
+        "wall_p50_ms": pct(walls, 0.50),
+        "wall_p99_ms": pct(walls, 0.99),
+        "per_replica": per_replica,
+    }
+
+
+def _fleet_prefix_counters(router) -> dict:
+    """Summed prefix-cache counters across every live replica's
+    /metrics snapshot (the replica-side truth the affinity A/B reads)."""
+    import urllib.request
+
+    lookups = hits = saved = 0
+    for r in router.replicas:
+        try:
+            with urllib.request.urlopen(
+                r.base_url + "/metrics", timeout=5
+            ) as resp:
+                snap = json.loads(resp.read().decode())
+        except OSError:
+            continue
+        lookups += snap.get("prefix_lookups", 0)
+        hits += snap.get("prefix_hits", 0)
+        saved += snap.get("prefix_tokens_saved", 0)
+    return {
+        "prefix_lookups": lookups,
+        "prefix_hits": hits,
+        "prefix_tokens_saved": saved,
+        "hit_rate": hits / lookups if lookups else 0.0,
+    }
+
+
+def _run_fleet_chaos_drill(args, geo) -> dict:
+    """One full chaos pass: fresh 3-replica fleet, bursty Zipf trace with
+    a seeded mid-trace SIGKILL, restart-within-budget wait, then the
+    rolling hot-swap (v1 -> v2) under continuing background traffic.
+
+    Raises RuntimeError on TIMING failures (fleet/restart/drain/ready
+    deadlines) so the --quick caller can apply the best-of-3 load-aware
+    retry; returns the measured result rows — including any dropped
+    requests, which the caller gates UNCONDITIONALLY — otherwise."""
+    import threading
+
+    from distributed_tensorflow_tpu.train.faultinject import FaultPlan
+
+    n = args.fleet_requests
+    payloads, gaps = make_fleet_trace(n, geo, args.fleet_seed)
+    plan = FaultPlan.generate(
+        args.fleet_seed, n, {"host_drop": 1}, min_step=max(1, n // 3)
+    )
+    kill_at = next(
+        e.step for e in plan.events if e.kind == "host_drop"
+    )
+
+    router, ports = _spawn_fleet(args, affinity_tokens=16)
+    try:
+        print(f"# fleet up on ports {ports}; fault plan seed "
+              f"{args.fleet_seed}: host_drop at request {kill_at}")
+        t0 = time.monotonic()
+        rows, victim = _drive_fleet_trace(
+            router, payloads, gaps, kill_at=kill_at
+        )
+        trace_wall = time.monotonic() - t0
+
+        # Victim back inside the progress-aware budget (timing gate).
+        deadline = time.monotonic() + args.restart_budget_s
+        restarted = False
+        while time.monotonic() < deadline:
+            fz = router.fleetz()
+            rep = next(
+                r for r in fz["replicas"] if r["name"] == victim.name
+            )
+            if (rep["state"] == "ready"
+                    and rep["supervisor"]["total_restarts"] >= 1):
+                restarted = True
+                break
+            time.sleep(0.25)
+        if not restarted:
+            raise RuntimeError(
+                f"victim {victim.name} not restarted+ready within "
+                f"{args.restart_budget_s:g}s "
+                f"(state={rep['state']}, "
+                f"supervisor={rep['supervisor']})"
+            )
+        restart_s = time.monotonic() - t0
+
+        # Rolling hot-swap under background traffic: v1 -> v2.
+        swap_rows: list[dict] = []
+        stop = threading.Event()
+
+        def background():
+            i = 0
+            while not stop.is_set():
+                p = dict(payloads[i % len(payloads)])
+                t1 = time.monotonic()
+                code, body = router.route("/v1/generate", p)
+                swap_rows.append({
+                    "code": code,
+                    "wall_ms": (time.monotonic() - t1) * 1e3,
+                    "ttft_ms": None,
+                    "replica": body.get("replica"),
+                    "shed": bool(body.get("shed")) or code == 429,
+                    "ok": code == 200
+                    and isinstance(body.get("tokens"), list),
+                })
+                i += 1
+                stop.wait(0.05)
+
+        me = os.path.abspath(__file__)
+        port_of = {
+            f"fleet-{i}": p for i, p in enumerate(ports)
+        }
+
+        def new_cmd(replica) -> list[str]:
+            c = [sys.executable, me, "--fleet", "--replica-serve",
+                 str(port_of[replica.name]), "--replica-tag", "fleet-v2"]
+            if args.quick:
+                c.append("--quick")
+            return c
+
+        bg = threading.Thread(target=background, daemon=True)
+        bg.start()
+        t1 = time.monotonic()
+        try:
+            swap = router.hot_swap(new_cmd, expected_tag="fleet-v2")
+        except RuntimeError:
+            raise  # timing gate: drain/ready deadline or tag mismatch
+        finally:
+            stop.set()
+            bg.join(timeout=10)
+        swap_wall = time.monotonic() - t1
+        tags = sorted({r.tag for r in router.replicas})
+
+        events = router.recorder.events()
+        kinds = {}
+        for e in events:
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+        return {
+            "trace": _fleet_stats(rows),
+            "trace_wall_s": trace_wall,
+            "kill_at": kill_at,
+            "victim": victim.name,
+            "victim_restart_s": restart_s,
+            "hot_swap": {
+                **_fleet_stats(swap_rows),
+                "swapped": len(swap["swapped"]),
+                "tags": tags,
+                "wall_s": swap_wall,
+            },
+            "prefix": _fleet_prefix_counters(router),
+            "fleetz": router.fleetz(),
+            "event_counts": kinds,
+        }
+    finally:
+        router.close()
+
+
+def _run_fleet_affinity_ab(args, geo) -> dict:
+    """Affinity vs spray: the SAME bursty Zipf trace (no chaos) through
+    two fresh fleets — affinity routing on vs pure load-based p2c — with
+    the per-replica KV pool sized to ~one hot head. Affinity partitions
+    the heads (fleet-wide cache works); spray thrashes every pool."""
+    n = args.fleet_requests
+    payloads, gaps = make_fleet_trace(n, geo, args.fleet_seed)
+    arms = {}
+    for name, aff in (("affinity", 16), ("spray", 0)):
+        router, ports = _spawn_fleet(args, affinity_tokens=aff)
+        try:
+            print(f"# {name} arm up on ports {ports}")
+            rows, _ = _drive_fleet_trace(router, payloads, gaps)
+            arms[name] = {
+                **_fleet_stats(rows),
+                **_fleet_prefix_counters(router),
+            }
+        finally:
+            router.close()
+    on, off = arms["affinity"], arms["spray"]
+    return {
+        **arms,
+        "ttft_p50_ratio": (
+            off["ttft_p50_ms"] / on["ttft_p50_ms"]
+            if on["ttft_p50_ms"] else 1.0
+        ),
+    }
+
+
+def run_fleet(args) -> int:
+    """The --fleet drill (round 16): chaos gate (+ affinity A/B on full
+    runs). Correctness — zero dropped non-shed requests, every replica
+    on the new tag after the swap — accumulates across EVERY attempt;
+    only the timing gates (fleet-up, restart-within-budget, drain/ready
+    deadlines, p99 bound) earn the best-of-3 load-aware retries."""
+    geo = _fleet_geo(args.quick)
+    attempts = 3 if args.quick else 1
+    dropped = 0
+    drill, last_err = None, None
+    for attempt in range(1, attempts + 1):
+        try:
+            cand = _run_fleet_chaos_drill(args, geo)
+        except RuntimeError as e:
+            last_err = str(e)
+            load = os.getloadavg()[0] / (os.cpu_count() or 1)
+            print(f"# fleet attempt {attempt}/{attempts}: {e} at "
+                  f"loadavg/core {load:.2f} — retrying", file=sys.stderr)
+            continue
+        dropped += cand["trace"]["failed"] + cand["hot_swap"]["failed"]
+        if drill is None or (
+            cand["trace"]["wall_p99_ms"]
+            < drill["trace"]["wall_p99_ms"]
+        ):
+            drill = cand
+        if (dropped == 0
+                and cand["trace"]["wall_p99_ms"]
+                <= args.fleet_slo_p99_ms):
+            drill = cand
+            break
+        if attempt < attempts and dropped == 0:
+            load = os.getloadavg()[0] / (os.cpu_count() or 1)
+            print(f"# fleet attempt {attempt}/{attempts}: wall p99 "
+                  f"{cand['trace']['wall_p99_ms']:.0f} ms at "
+                  f"loadavg/core {load:.2f} — retrying")
+        elif dropped:
+            break  # correctness failure: retries cannot launder it
+    if drill is None:
+        print(f"FAIL: every fleet attempt timed out: {last_err}",
+              file=sys.stderr)
+        return 1
+
+    tr, hs = drill["trace"], drill["hot_swap"]
+    print(f"\nfleet chaos drill ({args.fleet_replicas} replicas, "
+          f"{tr['requests']} requests, SIGKILL {drill['victim']} at "
+          f"request {drill['kill_at']}):")
+    hdr = (
+        f"{'phase':>9} {'ok':>5} {'shed':>5} {'failed':>7} "
+        f"{'ttft p50':>9} {'wall p50':>9} {'wall p99':>9} {'wall s':>7}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    print(
+        f"{'trace':>9} {tr['ok']:>5d} {tr['shed']:>5d} "
+        f"{tr['failed']:>7d} {tr['ttft_p50_ms']:>9.1f} "
+        f"{tr['wall_p50_ms']:>9.1f} {tr['wall_p99_ms']:>9.1f} "
+        f"{drill['trace_wall_s']:>7.1f}"
+    )
+    print(
+        f"{'hot-swap':>9} {hs['ok']:>5d} {hs['shed']:>5d} "
+        f"{hs['failed']:>7d} {'-':>9} {hs['wall_p50_ms']:>9.1f} "
+        f"{hs['wall_p99_ms']:>9.1f} {hs['wall_s']:>7.1f}"
+    )
+    spread = ", ".join(
+        f"{k}:{v}" for k, v in sorted(tr["per_replica"].items())
+    )
+    ev = drill["event_counts"]
+    print(f"# routed {spread}; victim back in "
+          f"{drill['victim_restart_s']:.1f}s; swap touched "
+          f"{hs['swapped']} replicas, tags now {hs['tags']}")
+    print(f"# prefix cache fleet-wide: hit rate "
+          f"{drill['prefix']['hit_rate']:.2f}, "
+          f"{drill['prefix']['prefix_tokens_saved']} tokens saved")
+    print(f"# router events: "
+          + ", ".join(f"{k}={ev.get(k, 0)}" for k in (
+              "router_spawn", "replica_lost", "replica_restart",
+              "hot_swap", "request_reject")))
+
+    ab = None
+    if not args.quick:
+        print("\n# affinity A/B: same trace, affinity routing vs "
+              "load-only spray (KV pool ~ one head per replica)")
+        ab = _run_fleet_affinity_ab(args, geo)
+        hdr = (
+            f"{'arm':>9} {'ok':>5} {'ttft p50':>9} {'ttft p99':>9} "
+            f"{'hit rate':>9} {'tok saved':>10}"
+        )
+        print(hdr)
+        print("-" * len(hdr))
+        for name in ("affinity", "spray"):
+            a = ab[name]
+            print(
+                f"{name:>9} {a['ok']:>5d} {a['ttft_p50_ms']:>9.1f} "
+                f"{a['ttft_p99_ms']:>9.1f} {a['hit_rate']:>9.2f} "
+                f"{a['prefix_tokens_saved']:>10d}"
+            )
+        print(f"affinity vs spray: ttft p50 "
+              f"{ab['ttft_p50_ratio']:.2f}x better "
+              f"(docs/PERF.md round 16)")
+
+    if args.json:
+        report = {
+            "mode": "fleet",
+            "config": {
+                "replicas": args.fleet_replicas,
+                "requests": args.fleet_requests,
+                "seed": args.fleet_seed,
+                "geo": {k: list(v) if isinstance(v, tuple) else v
+                        for k, v in geo.items()},
+            },
+            "drill": {k: v for k, v in drill.items() if k != "fleetz"},
+            "affinity_ab": ab,
+        }
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+        print(f"# wrote {args.json}")
+
+    # Correctness gates: unconditional, accumulated across every attempt.
+    if dropped:
+        print(f"FAIL: {dropped} requests dropped (non-shed, "
+              "non-retried) across the kill and hot-swap windows — the "
+              "door must lose ZERO admitted requests", file=sys.stderr)
+        return 1
+    if hs["tags"] != ["fleet-v2"]:
+        print(f"FAIL: hot-swap left tags {hs['tags']} (want "
+              "['fleet-v2']) — a replica silently restarted the old "
+              "deployment", file=sys.stderr)
+        return 1
+    if hs["swapped"] != args.fleet_replicas:
+        print(f"FAIL: hot-swap touched {hs['swapped']} of "
+              f"{args.fleet_replicas} replicas", file=sys.stderr)
+        return 1
+    if not (ev.get("replica_lost") and ev.get("replica_restart")
+            and ev.get("hot_swap")):
+        print(f"FAIL: flight recorder missing fleet events (got {ev}) — "
+              "the post-mortem story is incomplete", file=sys.stderr)
+        return 1
+    if args.quick:
+        if tr["wall_p99_ms"] > args.fleet_slo_p99_ms:
+            load = os.getloadavg()[0] / (os.cpu_count() or 1)
+            print(f"FAIL: fleet wall p99 {tr['wall_p99_ms']:.0f} ms "
+                  f"(> {args.fleet_slo_p99_ms:g} ms; best of {attempts} "
+                  f"attempts, loadavg/core {load:.2f})", file=sys.stderr)
+            return 1
+        if tr["shed"] > tr["requests"] // 4:
+            print(f"FAIL: {tr['shed']}/{tr['requests']} requests shed — "
+                  "one lost replica must not collapse door admission",
+                  file=sys.stderr)
+            return 1
+        if drill["prefix"]["hit_rate"] <= 0.0:
+            print("FAIL: fleet-wide prefix hit rate is 0 on a Zipf "
+                  "shared-head trace — affinity routing is not landing "
+                  "heads on warm replicas", file=sys.stderr)
+            return 1
+    return 0
+
+
 def _print_grid_summary(grid: dict) -> None:
     """The one-line AOT-grid digest (/compilez over the bench engine) so
     PERF.md rounds can attribute warmup cost."""
@@ -1542,6 +2156,27 @@ def main(argv=None) -> int:
                    help="continuous-batching decode A/B (simulated-step "
                    "engine + real-engine parity probe) instead of the "
                    "load sweep")
+    p.add_argument("--fleet", action="store_true",
+                   help="replicated-router chaos drill: N real replica "
+                   "processes behind serve/router.py, a seeded mid-trace "
+                   "SIGKILL, and a rolling hot-swap (round 16)")
+    p.add_argument("--fleet-replicas", type=int, default=3,
+                   help="replica processes in the fleet")
+    p.add_argument("--fleet-requests", type=int, default=60,
+                   help="requests in the bursty Zipf traffic trace")
+    p.add_argument("--fleet-seed", type=int, default=7,
+                   help="seed for the trace AND the FaultPlan placing "
+                   "the SIGKILL (same seed, same chaos)")
+    p.add_argument("--fleet-slo-p99-ms", type=float, default=20000.0,
+                   help="fleet-wide wall p99 bound for the --quick gate")
+    p.add_argument("--restart-budget-s", type=float, default=120.0,
+                   help="deadline for the SIGKILLed replica to be "
+                   "restarted and ready again")
+    p.add_argument("--replica-serve", type=int, default=0,
+                   help="internal: run as one fleet replica server on "
+                   "this port (spawned by --fleet)")
+    p.add_argument("--replica-tag", default="fleet-v1",
+                   help="internal: deployment tag surfaced on /healthz")
     p.add_argument("--slots", type=int, default=8,
                    help="KV-cache slot table size (decode mode)")
     p.add_argument("--max-new-tokens", type=int, default=64,
@@ -1581,6 +2216,10 @@ def main(argv=None) -> int:
         # freed slots) doesn't eat the continuous-admission margin.
         args.decode_requests = min(args.decode_requests, 64)
 
+    if args.fleet and args.replica_serve:
+        return run_fleet_replica(args)
+    if args.fleet:
+        return run_fleet(args)
     if args.decode:
         return run_decode(args)
     if args.mesh_layouts:

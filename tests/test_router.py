@@ -1,0 +1,615 @@
+"""Fleet router: balancing-policy math, supervision verdicts, failover
+bounds, drain-aware routing, and a 2-process kill-and-failover drill
+(serve/router.py, obs/fleet.ReplicaSupervisor)."""
+
+import random
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from distributed_tensorflow_tpu.obs.fleet import ReplicaSupervisor
+from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+from distributed_tensorflow_tpu.obs.sanitizer import sanitize_races
+from distributed_tensorflow_tpu.serve import router as router_mod
+from distributed_tensorflow_tpu.serve.batcher import BatcherConfig
+from distributed_tensorflow_tpu.serve.engine import RequestError
+from distributed_tensorflow_tpu.serve.router import (
+    Router,
+    RouterConfig,
+    build_router_server,
+    pick_power_of_two,
+    prefix_affinity_key,
+    rendezvous_pick,
+    replica_load,
+)
+from distributed_tensorflow_tpu.serve.server import Client, build_http_server
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------ policy math
+
+
+def test_replica_load_sums_queue_in_flight_slots():
+    assert replica_load(
+        {"queue_depth": 3, "in_flight": 2, "slots_active": 4}
+    ) == 9.0
+    # Missing keys count zero: flush-mode and stub replicas rank on the
+    # same scale as decode replicas.
+    assert replica_load({"queue_depth": 1}) == 1.0
+    assert replica_load({}) == 0.0
+
+
+def test_pick_power_of_two_prefers_less_loaded():
+    rng = random.Random(0)
+    # Two replicas: both are always sampled, so the cooler one must win
+    # every single draw.
+    assert all(
+        pick_power_of_two([0.0, 100.0], rng) == 0 for _ in range(50)
+    )
+    # Single replica and empty input edge cases.
+    assert pick_power_of_two([7.0], rng) == 0
+    with pytest.raises(ValueError):
+        pick_power_of_two([], rng)
+
+
+def test_pick_power_of_two_spreads_under_equal_load():
+    rng = random.Random(1)
+    counts = [0] * 4
+    for _ in range(400):
+        counts[pick_power_of_two([1.0] * 4, rng)] += 1
+    # Equal loads: ties go to the first sampled index, which is uniform —
+    # every replica gets a meaningful share.
+    assert all(c > 40 for c in counts), counts
+
+
+def test_pick_power_of_two_beats_hot_replica():
+    rng = random.Random(2)
+    loads = [10.0, 0.0, 10.0, 10.0]
+    hits = sum(
+        1 for _ in range(300) if pick_power_of_two(loads, rng) == 1
+    )
+    # The cool replica wins every draw that samples it (1 - C(3,2)/C(4,2)
+    # = half the draws); well above its uniform 1/4 share.
+    assert hits > 100, hits
+
+
+def test_prefix_affinity_key_stable_and_head_only():
+    key = prefix_affinity_key([3, 1, 4, 1, 5, 9, 2, 6], 16)
+    # Pinned literal: blake2b is process- and run-stable (unlike hash()),
+    # so a restarted router maps the same prompt heads to the same
+    # replicas. If this changes, every warm prefix cache goes cold.
+    assert key == "9757941b9a901cd3"
+    # numpy-ish inputs hash identically to plain ints.
+    assert prefix_affinity_key((3, 1, 4, 1, 5, 9, 2, 6), 16) == key
+    # Only the head participates: same first 4 tokens, different tails.
+    a = prefix_affinity_key([1, 2, 3, 4, 7, 8], 4)
+    b = prefix_affinity_key([1, 2, 3, 4, 9, 10, 11], 4)
+    assert a == b
+    assert prefix_affinity_key([1, 2, 3, 5, 7, 8], 4) != a
+    assert prefix_affinity_key([], 16) is None
+
+
+def test_rendezvous_pick_stable_and_minimal_remap():
+    names = [f"replica-{i}" for i in range(5)]
+    keys = [f"k{i}" for i in range(200)]
+    placed = {k: rendezvous_pick(k, names) for k in keys}
+    # Deterministic: same inputs, same picks.
+    assert placed == {k: rendezvous_pick(k, names) for k in keys}
+    # Losing one replica remaps ONLY the keys that lived on it — the
+    # property that keeps survivors' prefix caches warm through a loss.
+    lost = "replica-2"
+    survivors = [n for n in names if n != lost]
+    for k in keys:
+        if placed[k] != lost:
+            assert rendezvous_pick(k, survivors) == placed[k]
+
+
+# ------------------------------------------------------ ReplicaSupervisor
+
+
+def test_supervisor_threshold_then_restart_verdict():
+    sup = ReplicaSupervisor(fail_threshold=3, max_restarts=2)
+    sup.record_poll(False)
+    sup.record_poll(False)
+    assert sup.verdict() == "none"  # below threshold: transient blip
+    sup.record_poll(True)           # one good poll resets the count
+    sup.record_poll(False)
+    sup.record_poll(False)
+    assert sup.verdict() == "none"
+    sup.record_poll(False)
+    assert sup.verdict() == "restart"
+
+
+def test_supervisor_budget_exhaustion_quarantines():
+    sup = ReplicaSupervisor(
+        fail_threshold=1, max_restarts=2, backoff_base_s=0.5,
+        backoff_factor=2.0, backoff_max_s=30.0,
+    )
+    sup.record_poll(False)
+    assert sup.verdict() == "restart"
+    assert sup.record_restart() == 0.5
+    sup.record_poll(False)
+    assert sup.verdict() == "restart"
+    assert sup.record_restart() == 1.0  # exponential backoff
+    sup.record_poll(False)
+    assert sup.verdict() == "quarantine"  # budget burned, never re-restart
+    assert sup.summary()["total_restarts"] == 2
+
+
+def test_supervisor_progress_resets_budget():
+    """The training-resilience semantics: a replica that comes back READY
+    made progress — only back-to-back failures burn the budget."""
+    sup = ReplicaSupervisor(fail_threshold=1, max_restarts=2)
+    for _ in range(10):  # far past max_restarts in total
+        sup.record_poll(False)
+        assert sup.verdict() == "restart"
+        sup.record_restart()
+        sup.record_ready()  # progress: consecutive count resets
+    assert sup.summary()["total_restarts"] == 10
+    assert sup.summary()["consecutive_restarts"] == 0
+
+
+def test_supervisor_backoff_caps():
+    sup = ReplicaSupervisor(
+        fail_threshold=1, max_restarts=10,
+        backoff_base_s=1.0, backoff_factor=10.0, backoff_max_s=5.0,
+    )
+    assert sup.record_restart() == 1.0
+    assert sup.record_restart() == 5.0  # capped, not 10.0
+    assert sup.record_restart() == 5.0
+
+
+# ----------------------------------------- in-process fleets (real HTTP)
+
+
+class _StubEngine:
+    max_batch = 8
+
+    def validate(self, payload):
+        if "input_ids" not in payload:
+            raise RequestError("input_ids required")
+
+    def run_batch(self, payloads):
+        return [
+            {"pred_ids": [int(t) for t in p["input_ids"]], "score": 0.0}
+            for p in payloads
+        ]
+
+
+class _StubStack:
+    """One in-process replica: Client + HTTP server on an ephemeral port."""
+
+    def __init__(self):
+        self.client = Client(
+            _StubEngine(), BatcherConfig(max_batch=8, max_delay_ms=1.0)
+        )
+        self.server = build_http_server(self.client, port=0)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+        host, port = self.server.server_address
+        self.url = f"http://{host}:{port}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.client.close()
+        self.thread.join(timeout=5)
+
+
+@pytest.fixture()
+def stub_fleet():
+    """Three adopted in-process replicas behind a fast-polling router."""
+    stacks = [_StubStack() for _ in range(3)]
+    recorder = FlightRecorder(capacity=256)
+    router = Router(
+        [(f"replica-{i}", s.url, None) for i, s in enumerate(stacks)],
+        RouterConfig(
+            poll_interval_s=0.05, poll_timeout_s=2.0, fail_threshold=2,
+            max_retries=2, request_timeout_s=10.0, affinity_tokens=4,
+        ),
+        recorder=recorder,
+    )
+    router.start()
+    assert router.wait_ready(3, timeout=10)
+    yield router, stacks, recorder
+    router.close()
+    for s in stacks:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def test_router_routes_and_labels_replica(stub_fleet):
+    router, _, _ = stub_fleet
+    code, body = router.route("/v1/mlm", {"input_ids": [1, 2, 3]})
+    assert code == 200
+    assert body["pred_ids"] == [1, 2, 3]
+    assert body["replica"].startswith("replica-")
+    assert body["request_id"]
+    z = router.fleetz()
+    assert z["requests"] == 1
+    assert z["n_ready"] == 3
+
+
+def test_router_affinity_pins_prompt_head(stub_fleet):
+    """Same prompt head -> same replica, across many requests and
+    regardless of tail content (the prefix-cache warmth contract)."""
+    router, _, _ = stub_fleet
+    served = set()
+    for tail in range(12):
+        code, body = router.route(
+            "/v1/mlm", {"input_ids": [5, 6, 7, 8, 100 + tail]}
+        )
+        assert code == 200
+        served.add(body["replica"])
+    assert len(served) == 1, served
+    # A different head may land elsewhere; with 64 heads at least one
+    # must (rendezvous spreads keys across all replicas).
+    heads = {
+        router.route("/v1/mlm", {"input_ids": [h, h + 1]})[1]["replica"]
+        for h in range(0, 128, 2)
+    }
+    assert len(heads) > 1, heads
+
+
+def test_router_bad_request_is_final_not_retried(stub_fleet):
+    router, _, _ = stub_fleet
+    code, body = router.route("/v1/mlm", {"wrong": True})
+    assert code == 400
+    assert router.fleetz()["retries"] == 0  # malformed everywhere: no hops
+
+
+def test_router_failover_onto_survivor(stub_fleet):
+    router, stacks, recorder = stub_fleet
+    stacks[0].stop()  # connection refused from now on
+    # Until the poll threshold flips it down, routing may still pick the
+    # dead replica — every request must still succeed via failover.
+    for i in range(20):
+        code, body = router.route("/v1/mlm", {"input_ids": [i]})
+        assert code == 200, body
+    # The poll loop marks it lost (adopted replica: down, not restarted).
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        states = {
+            r["name"]: r["state"] for r in router.fleetz()["replicas"]
+        }
+        if states["replica-0"] == "down":
+            break
+        time.sleep(0.05)
+    assert states["replica-0"] == "down", states
+    kinds = [e["kind"] for e in recorder.events()]
+    assert "replica_lost" in kinds
+
+
+def test_router_sheds_with_request_id_when_fleet_is_gone(stub_fleet):
+    router, stacks, _ = stub_fleet
+    for s in stacks:
+        s.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if router.fleetz()["n_ready"] == 0:
+            break
+        time.sleep(0.05)
+    code, body = router.route("/v1/mlm", {"input_ids": [1]})
+    assert code == 503
+    assert body["shed"] is True
+    assert body["request_id"]  # minted at the door: shed load stays
+    assert router.fleetz()["shed"] >= 1  # attributable
+
+
+def test_router_retry_bound_is_exact(monkeypatch):
+    """max_retries bounds the failover hops: attempts = 1 + max_retries,
+    never more, each on a distinct replica."""
+    calls = []
+
+    def dead_post(url, payload, rid, timeout):
+        calls.append(url)
+        raise OSError("synthetic transport failure")
+
+    monkeypatch.setattr(router_mod, "_post_json", dead_post)
+    router = Router(
+        [(f"replica-{i}", f"http://127.0.0.1:{59000 + i}", None)
+         for i in range(4)],
+        RouterConfig(max_retries=2, seed=0),
+    )
+    # Force routable state without a poll thread.
+    with router._lock:
+        for r in router.replicas:
+            r.state = "ready"
+    code, body = router.route("/v1/mlm", {"input_ids": [1]})
+    assert code == 503 and body["shed"] is True
+    assert len(calls) == 3  # 1 attempt + 2 failover hops
+    assert len(set(calls)) == 3  # all distinct replicas
+    assert router.fleetz()["retries"] == 2
+
+
+def test_router_drain_aware_routing(stub_fleet):
+    """A draining replica leaves the routable set (poll sees the 503
+    body) but is NOT restarted — draining is intentional, not a loss."""
+    router, stacks, recorder = stub_fleet
+    stacks[0].client.start_draining()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        states = {
+            r["name"]: r["state"] for r in router.fleetz()["replicas"]
+        }
+        if states["replica-0"] == "draining":
+            break
+        time.sleep(0.05)
+    assert states["replica-0"] == "draining", states
+    for i in range(20):
+        code, body = router.route("/v1/mlm", {"input_ids": [i]})
+        assert code == 200
+        assert body["replica"] != "replica-0"
+    assert "replica_lost" not in [e["kind"] for e in recorder.events()]
+
+
+def test_router_door_backpressure():
+    router = Router(
+        [("replica-0", "http://127.0.0.1:59999", None)],
+        RouterConfig(max_in_flight_per_replica=1),
+    )
+    with router._lock:
+        router.replicas[0].state = "ready"
+        router.replicas[0].in_flight = 1  # at the cap
+    code, body = router.route("/v1/mlm", {"input_ids": [1]})
+    assert code == 429
+    assert body["retry_after_s"] > 0
+    assert body["request_id"]
+    assert router.fleetz()["door_429"] == 1
+
+
+def test_router_http_face(stub_fleet):
+    import json
+    import urllib.request
+
+    router, _, _ = stub_fleet
+    front = build_router_server(router, port=0)
+    t = threading.Thread(target=front.serve_forever, daemon=True)
+    t.start()
+    base = "http://%s:%d" % front.server_address
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["n_ready"] == 3
+        with urllib.request.urlopen(base + "/fleetz", timeout=10) as r:
+            z = json.loads(r.read())
+            assert len(z["replicas"]) == 3
+        req = urllib.request.Request(
+            base + "/v1/mlm",
+            data=json.dumps({"input_ids": [4, 5]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "fixed-id"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+            assert body["pred_ids"] == [4, 5]
+            assert body["request_id"] == "fixed-id"
+            assert body["replica"].startswith("replica-")
+        with urllib.request.urlopen(
+            base + "/metrics?format=prom", timeout=10
+        ) as r:
+            text = r.read().decode()
+            assert "router_replica_up{" in text
+            assert "router_requests_total{" in text
+    finally:
+        front.shutdown()
+        front.server_close()
+        t.join(timeout=5)
+
+
+# ------------------------------------------------- sanitizer soak
+
+
+def test_router_race_soak():
+    """Concurrent routing + the poll thread under the race sanitizer:
+    every access to the router's declared shared state must be
+    happens-before ordered. The router is BUILT inside the context so
+    its poll thread is tracked."""
+    stacks = [_StubStack() for _ in range(2)]
+    try:
+        with sanitize_races(modules=[router_mod]) as san:
+            router = Router(
+                [(f"replica-{i}", s.url, None)
+                 for i, s in enumerate(stacks)],
+                RouterConfig(
+                    poll_interval_s=0.02, fail_threshold=2,
+                    request_timeout_s=10.0,
+                ),
+            )
+            router.start()
+            assert router.wait_ready(2, timeout=10)
+            errs = []
+
+            def worker(base):
+                try:
+                    for i in range(12):
+                        code, _ = router.route(
+                            "/v1/mlm", {"input_ids": [base, i]}
+                        )
+                        assert code == 200
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [
+                threading.Thread(target=worker, args=(k,), daemon=True)
+                for k in range(4)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            router.close()
+        assert not errs, errs
+        san.assert_clean()
+    finally:
+        for s in stacks:
+            s.stop()
+
+
+# ------------------------------------- 2-process kill-and-failover drill
+
+
+@pytest.mark.slow
+def test_kill_and_failover_two_processes(tmp_path):
+    """The chaos headline over real processes: SIGKILL one of two spawned
+    replicas mid-traffic; every request still answers 200 (failover onto
+    the survivor) and the router restarts the dead replica within its
+    budget."""
+    import socket
+
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    helper = str(REPO_ROOT / "tests" / "_router_replica.py")
+
+    def make_cmd(name, port):
+        return [sys.executable, helper, str(port), "v1"]
+
+    recorder = FlightRecorder(capacity=1024)
+    router = Router(
+        [
+            (f"replica-{i}", f"http://127.0.0.1:{p}",
+             make_cmd(f"replica-{i}", p))
+            for i, p in enumerate(ports)
+        ],
+        RouterConfig(
+            poll_interval_s=0.1, poll_timeout_s=2.0, fail_threshold=2,
+            max_restarts=3, backoff_base_s=0.2, start_grace_s=120.0,
+            request_timeout_s=30.0,
+        ),
+        recorder=recorder,
+        log_dir=tmp_path,
+    )
+    try:
+        router.start()
+        assert router.wait_ready(2, timeout=90), router.fleetz()
+        failures = []
+        stop = threading.Event()
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                code, body = router.route(
+                    "/v1/mlm", {"input_ids": [i % 50, (i * 7) % 50]}
+                )
+                if code != 200:
+                    failures.append((code, body))
+                i += 1
+                time.sleep(0.005)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.5)  # traffic flowing on both replicas
+        victim = router.replicas[0]
+        victim.proc.send_signal(signal.SIGKILL)
+        # The drill: zero failed requests through detection + restart.
+        deadline = time.monotonic() + 90
+        restarted = False
+        while time.monotonic() < deadline:
+            z = router.fleetz()
+            rep = z["replicas"][0]
+            if (
+                rep["supervisor"]["total_restarts"] >= 1
+                and rep["state"] == "ready"
+            ):
+                restarted = True
+                break
+            time.sleep(0.1)
+        time.sleep(0.5)  # traffic lands on the restarted replica too
+        stop.set()
+        t.join(timeout=30)
+        assert restarted, router.fleetz()
+        assert not failures, failures[:5]
+        kinds = [e["kind"] for e in recorder.events()]
+        assert "replica_lost" in kinds
+        assert "replica_restart" in kinds
+        assert kinds.count("router_spawn") >= 3  # 2 spawns + 1 relaunch
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_hot_swap_two_processes_zero_loss(tmp_path):
+    """Rolling checkpoint hot-swap across 2 real replicas under traffic:
+    zero request failures, and every replica comes back on the new tag."""
+    import socket
+
+    ports = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    helper = str(REPO_ROOT / "tests" / "_router_replica.py")
+    recorder = FlightRecorder(capacity=1024)
+    router = Router(
+        [
+            (f"replica-{i}", f"http://127.0.0.1:{p}",
+             [sys.executable, helper, str(p), "v1"])
+            for i, p in enumerate(ports)
+        ],
+        RouterConfig(
+            poll_interval_s=0.1, fail_threshold=2, start_grace_s=120.0,
+            ready_timeout_s=120.0, drain_timeout_s=60.0,
+            request_timeout_s=30.0,
+        ),
+        recorder=recorder,
+        log_dir=tmp_path,
+    )
+    try:
+        router.start()
+        assert router.wait_ready(2, timeout=90), router.fleetz()
+        assert {r.tag for r in router.replicas} == {"v1"}
+        failures = []
+        stop = threading.Event()
+
+        def traffic():
+            i = 0
+            while not stop.is_set():
+                code, body = router.route(
+                    "/v1/mlm", {"input_ids": [i % 50]}
+                )
+                if code != 200:
+                    failures.append((code, body))
+                i += 1
+                time.sleep(0.005)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.3)
+
+        def new_cmd(replica):
+            port = replica.base_url.rsplit(":", 1)[1]
+            return [sys.executable, helper, port, "v2"]
+
+        out = router.hot_swap(new_cmd, expected_tag="v2")
+        time.sleep(0.3)
+        stop.set()
+        t.join(timeout=30)
+        assert len(out["swapped"]) == 2
+        assert not failures, failures[:5]
+        with router._lock:
+            tags = {r.tag for r in router.replicas}
+        assert tags == {"v2"}, tags
+        stages = [
+            (e.get("replica"), e["stage"])
+            for e in recorder.events() if e["kind"] == "hot_swap"
+        ]
+        assert ("replica-0", "drain") in stages
+        assert ("replica-1", "ready") in stages
+        assert (None, "done") in stages
+    finally:
+        router.close()

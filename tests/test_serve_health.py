@@ -413,3 +413,154 @@ def test_prometheus_text_without_slo_or_health():
     assert samples2[
         ("serve_slo_verdict", (("slo", "latency_p99"),))
     ] == 0.0
+
+
+# ------------------------------------------- drain hardening (router PR)
+
+def _post_json(url, payload, request_id=None):
+    headers = {"Content-Type": "application/json"}
+    if request_id:
+        headers["X-Request-Id"] = request_id
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=headers,
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_drain_lifecycle_completes_in_flight_and_sheds_new():
+    """The full router-initiated drain over real HTTP: /drainz flips the
+    probe to 503, work already admitted still completes, and a NEW submit
+    arriving mid-drain is shed immediately with a request_id — it must
+    not hang behind the drain or enqueue after it."""
+    class _SignallingEngine(_BlockingEngine):
+        def __init__(self):
+            super().__init__()
+            self.entered = threading.Event()
+
+        def run_batch(self, payloads):
+            self.entered.set()  # the batch is now IN the engine
+            return super().run_batch(payloads)
+
+    engine = _SignallingEngine()
+    client = Client(engine, BatcherConfig(max_batch=4, max_delay_ms=1.0))
+    server, thread, base = _serve(client)
+    port = server.server_address[1]
+    try:
+        # One request in flight, parked inside the engine.
+        results = {}
+
+        def in_flight():
+            results["resp"] = _post_json(
+                base + "/v1/mlm", {"input_ids": [1, 2]}, "inflight-1"
+            )
+
+        t = threading.Thread(target=in_flight, daemon=True)
+        t.start()
+        assert engine.entered.wait(timeout=10)
+
+        # Drain: probe goes 503/draining while the in-flight request
+        # keeps running.
+        code, body = _post(base + "/drainz")
+        assert code == 200 and body["status"] == "draining"
+        code, body, _ = _get(base + "/healthz")
+        assert code == 503 and body["status"] == "draining"
+
+        # Mid-drain submit: shed with a request_id, answered promptly
+        # (the 10s client timeout inside _post_json is the hang guard).
+        code, body = _post_json(
+            base + "/v1/mlm", {"input_ids": [9]}, "mid-drain-1"
+        )
+        assert code == 503
+        assert body["status"] == "draining"
+        assert body["request_id"] == "mid-drain-1"
+        # A shed without a caller-supplied id still mints one.
+        code, body = _post_json(base + "/v1/mlm", {"input_ids": [9]})
+        assert code == 503 and body["request_id"]
+        assert client.metrics.snapshot()["rejected_by_cause"].get(
+            "draining", 0
+        ) >= 2
+
+        # Release the engine: the in-flight request completes with 200 —
+        # the drain never dropped admitted work.
+        engine.release.set()
+        t.join(timeout=10)
+        code, body = results["resp"]
+        assert code == 200
+        assert body["pred_ids"] == [1, 2]
+        assert body["request_id"] == "inflight-1"
+        assert client.batcher.status()["served"] >= 1
+    finally:
+        engine.release.set()
+        server.shutdown()
+        server.server_close()
+        client.close()
+        thread.join(timeout=5)
+
+    # Drained is terminal for THIS stack (draining -> ready is a
+    # forbidden silent un-drain): re-ready means a fresh stack on the
+    # SAME port — exactly what the router's hot-swap does.
+    client2 = Client(
+        _StubEngine(), BatcherConfig(max_batch=4, max_delay_ms=1.0)
+    )
+    server2 = build_http_server(client2, port=port)
+    thread2 = threading.Thread(target=server2.serve_forever, daemon=True)
+    thread2.start()
+    try:
+        code, body, _ = _get(base + "/healthz")
+        assert code == 200 and body["status"] == "ready"
+        code, body = _post_json(base + "/v1/mlm", {"input_ids": [3]})
+        assert code == 200 and body["pred_ids"] == [3]
+    finally:
+        server2.shutdown()
+        server2.server_close()
+        client2.close()
+        thread2.join(timeout=5)
+
+
+def test_draining_submit_raises_with_request_id_in_process():
+    """Client-level drain shed: submit() during drain raises Draining
+    carrying the (minted) request_id instead of enqueueing."""
+    from distributed_tensorflow_tpu.serve.server import Draining
+
+    client = Client(_StubEngine(), BatcherConfig(max_batch=4))
+    try:
+        client.start_draining()
+        with pytest.raises(Draining) as ei:
+            client.submit({"input_ids": [1]})
+        assert ei.value.request_id
+        assert ei.value.state == "draining"
+        with pytest.raises(Draining) as ei2:
+            client.submit({"input_ids": [1]}, request_id="keep-me")
+        assert ei2.value.request_id == "keep-me"
+    finally:
+        client.close()
+
+
+def test_healthz_carries_tag_and_statusz_served():
+    """The hot-swap verification surface: /healthz answers the deployment
+    tag, /statusz mirrors it, and the batcher's served counter moves."""
+    client = Client(
+        _StubEngine(), BatcherConfig(max_batch=4, max_delay_ms=1.0),
+        tag="ckpt-1234",
+    )
+    server, thread, base = _serve(client)
+    try:
+        code, body, _ = _get(base + "/healthz")
+        assert code == 200
+        assert body["tag"] == "ckpt-1234"
+        assert body["served"] == 0
+        code, body = _post_json(base + "/v1/mlm", {"input_ids": [1]})
+        assert code == 200
+        code, body, _ = _get(base + "/statusz")
+        assert body["tag"] == "ckpt-1234"
+        assert body["batcher"]["served"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        client.close()
+        thread.join(timeout=5)
