@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick chaos-quick fleet-quick
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick disagg-quick chaos-quick fleet-quick
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -31,6 +31,17 @@ obs-quick:
 # docs/PERF.md round 14).
 decode-quick:
 	$(PY) scripts/serve_bench.py --decode --quick
+
+# Disaggregated prefill/decode gate (sub-60s): the wire-format/budget/
+# role-planning/adoption unit suite, then the serve_bench --disagg A/B —
+# real-engine parity probe (disagg streams bit-identical to a colocated
+# engine, every distinct prompt adopted from a transferred chain) and the
+# head-of-line A/B (colocated admission ITL p99 blows past steady state
+# under long-prompt load while the disagg decode role holds <=1.5x;
+# best-of-3 on timing, parity unconditional). docs/DEPLOY.md runbook.
+disagg-quick:
+	$(PY) -m pytest tests/test_disagg.py -q
+	$(PY) scripts/serve_bench.py --disagg --quick
 
 # Survive-the-cluster gate (~30s): the fault-injection/preemption/elastic
 # re-mesh unit suite plus the 2-process chaos rehearsal — seeded FaultPlan

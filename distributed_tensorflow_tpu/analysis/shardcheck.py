@@ -20,6 +20,10 @@ Two halves:
   ``rejects`` (clean ValueError at startup). Anything else — an unexpected
   exception type, or a layout the planner accepts but the engine then dies
   on — is a finding: it would surface as a raw XLA error on real hardware.
+  Decode cells that serve are further crossed with the prefix-cache,
+  speculation, and disaggregated role-split plans (``DISAGG_VARIANTS`` →
+  ``parallel/mesh.py::plan_disagg_mesh``), each under the same
+  plan-or-clean-ValueError contract.
 """
 
 from __future__ import annotations
@@ -76,6 +80,22 @@ SPEC_VARIANTS: tuple[tuple[int, int, int], ...] = (
     (4, 2, 32),
     (8, 3, 32),
     (32, 2, 32),   # spec_tokens == max_new_tokens: must reject
+)
+
+# Disaggregated-serving role splits crossed into every decode cell that
+# serves: (prefill_devices, prefill_tp, decode_tp) over the sweep's
+# 8-device topology. plan_disagg_mesh holds the same plan-or-clean-
+# ValueError contract as plan_serve_mesh: oversized asks shrink with a
+# note, non-dividing role tp drops to the largest divisor, and only
+# genuinely invalid inputs (the 0 row) may reject — anything else raised
+# would be a raw startup crash on a real role split.
+DISAGG_VARIANTS: tuple[tuple[int, int, int], ...] = (
+    (-1, 1, 1),  # auto half split, no role tp
+    (-1, 2, 2),  # tp on both roles
+    (2, 2, 4),   # explicit prefill subset, asymmetric tp
+    (8, 1, 1),   # prefill wants the whole slice: must shrink, never crash
+    (-1, 3, 1),  # non-dividing prefill tp: must fall back to a divisor
+    (0, 1, 1),   # invalid: must reject with a clean ValueError
 )
 
 # Mesh layouts exercised by tests/test_serve_mesh.py plus the CLI default
@@ -195,6 +215,7 @@ def run_config_sweep(
     from ..cli.train import PRESETS
     from ..models.bert import BertConfig
     from ..models.causal_lm import CausalLMConfig
+    from ..parallel.mesh import plan_disagg_mesh
     from ..serve.engine import (
         BertInferenceEngine,
         CausalLMEngine,
@@ -365,6 +386,58 @@ def run_config_sweep(
                             splans.append({
                                 "spec_tokens": sk, "min_match": mm,
                                 "max_new_tokens": mnt,
+                                "raised": type(exc).__name__,
+                            })
+                    # And the disaggregated role split (parallel/mesh.py
+                    # plan_disagg_mesh): every role-split variant on this
+                    # topology must return a plan (fallbacks noted) or
+                    # reject with a clean ValueError — a split that only
+                    # dies when the role engines build would be a raw
+                    # startup crash on a disaggregated fleet.
+                    cell["disagg"] = dplans = []
+                    for pd, ptp, dtp in DISAGG_VARIANTS:
+                        try:
+                            plan = plan_disagg_mesh(
+                                n_devices, prefill_devices=pd,
+                                prefill_tp=ptp, decode_tp=dtp,
+                            )
+                            dplans.append({
+                                "prefill_devices": pd, "prefill_tp": ptp,
+                                "decode_tp": dtp,
+                                "prefill": len(plan.prefill_device_ids),
+                                "decode": len(plan.decode_device_ids),
+                                "fell_back": plan.fell_back,
+                                "notes": len(plan.notes),
+                            })
+                        except ValueError as exc:
+                            dplans.append({
+                                "prefill_devices": pd, "prefill_tp": ptp,
+                                "decode_tp": dtp, "rejects": str(exc),
+                            })
+                        except Exception as exc:
+                            findings.append(
+                                Finding(
+                                    check="SC002",
+                                    path=(
+                                        "distributed_tensorflow_tpu/"
+                                        "parallel/mesh.py"
+                                    ),
+                                    line=0,
+                                    scope="plan_disagg_mesh",
+                                    message=(
+                                        f"disagg role split "
+                                        f"prefill_devices={pd} "
+                                        f"prefill_tp={ptp} decode_tp={dtp} "
+                                        f"on {n_devices} devices raised "
+                                        f"{type(exc).__name__} instead of "
+                                        f"a plan or a clean ValueError: "
+                                        f"{exc}"
+                                    ),
+                                )
+                            )
+                            dplans.append({
+                                "prefill_devices": pd, "prefill_tp": ptp,
+                                "decode_tp": dtp,
                                 "raised": type(exc).__name__,
                             })
             except ValueError as exc:

@@ -174,6 +174,10 @@ def build_serving_client(cfg, args):
             spec_tokens=args.spec_tokens,
             spec_min_match=args.spec_min_match,
             spec_backoff=args.spec_backoff,
+            # Disaggregated-serving roles move KV-page chains between
+            # engines; the export/import executables are compiled at
+            # startup like the rest of the grid.
+            kv_transfer=bool(getattr(args, "disagg_role", "")),
         )
         vocab = pieces["model"].cfg.vocab_size
 
@@ -326,6 +330,23 @@ def main(argv: list[str] | None = None):
                         help="per-slot acceptance-EMA threshold below "
                         "which speculation backs off to plain decode "
                         "(re-probing periodically)")
+    # Disaggregated prefill/decode serving (see DEPLOY.md "Disaggregated
+    # serving"): run this process as ONE role of a prefill/decode pair.
+    # A decode-role server compiles the KV-page import executable and
+    # accepts chains on POST /v1/kv_transfer (serve/disagg.py wire
+    # format); a prefill-role server is an ordinary chunked-prefill
+    # engine whose operators cap max_new_tokens at 1 and ship the
+    # published pages with serve.disagg.post_kv_transfer.
+    parser.add_argument("--disagg-role", default="",
+                        choices=["", "prefill", "decode"],
+                        help="disaggregated-serving role; decode requires "
+                        "--prefix-cache-mb > 0 (the adopted chains land "
+                        "in the prefix-cache page pool)")
+    parser.add_argument("--kv-transfer-budget-mb", type=float, default=64.0,
+                        help="bytes-in-flight cap (MiB) for inbound KV-page "
+                        "transfers on a decode-role server; transfers "
+                        "beyond it queue briefly then shed with 429 + "
+                        "Retry-After (the sender re-prefills instead)")
     parser.add_argument("--flush-admission", action="store_true",
                         help="admit new requests only when the slot table "
                         "is EMPTY (static batching; the A/B baseline for "
@@ -402,6 +423,10 @@ def main(argv: list[str] | None = None):
                         help="serve N synthetic requests in-process and "
                         "exit (no HTTP socket)")
     args = parser.parse_args(argv)
+    if args.disagg_role == "decode" and args.prefix_cache_mb <= 0:
+        parser.error("--disagg-role decode requires --prefix-cache-mb > 0 "
+                     "(adopted KV-page chains land in the prefix-cache "
+                     "page pool)")
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s: %(message)s"
@@ -431,8 +456,37 @@ def main(argv: list[str] | None = None):
             return _selftest(client, make_payload, args.selftest)
         from distributed_tensorflow_tpu.serve import build_http_server
 
+        kv_receiver = transfer_budget = None
+        if args.disagg_role == "decode":
+            from distributed_tensorflow_tpu.serve.disagg import (
+                TransferBudget,
+                make_kv_receiver,
+            )
+
+            transfer_budget = TransferBudget(
+                int(args.kv_transfer_budget_mb * 1024 * 1024)
+            )
+            kv_receiver = make_kv_receiver(
+                client.batcher,
+                client.engine,
+                budget=transfer_budget,
+                metrics=client.metrics,
+                recorder=client.recorder,
+            )
+            logger.info(
+                "disaggregated decode role: accepting KV-page chains on "
+                "POST /v1/kv_transfer (budget %.1f MiB in flight)",
+                args.kv_transfer_budget_mb,
+            )
+        elif args.disagg_role == "prefill":
+            logger.info(
+                "disaggregated prefill role: operators should cap "
+                "max_new_tokens at 1 and ship published pages with "
+                "serve.disagg.post_kv_transfer"
+            )
         server = build_http_server(
-            client, args.host, args.port, trace_dir=args.trace_dir or None
+            client, args.host, args.port, trace_dir=args.trace_dir or None,
+            kv_receiver=kv_receiver, transfer_budget=transfer_budget,
         )
         logger.info(
             "ready on http://%s:%d (POST /v1/%s; GET /healthz /sloz "

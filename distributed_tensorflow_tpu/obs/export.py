@@ -221,6 +221,21 @@ def serve_families(
         layout_buckets.add(v, labels)
     fams.append(layout_buckets)
 
+    # Disaggregated-serving KV-page transfer families (serve/disagg.py),
+    # role-labelled ("prefill" = chain exported, "decode" = chain adopted).
+    kv_bytes = Family("serve_kv_transfer_bytes_total", "counter",
+                      "KV-page bytes moved between engine roles")
+    for role, v in m.kv_transfer_bytes.snapshot().items():
+        kv_bytes.add(v, {"role": role})
+    fams.append(kv_bytes)
+    kv_secs = m.kv_transfer_seconds.snapshot()
+    if kv_secs:
+        fams.append(_summary_quantiles(
+            "serve_kv_transfer_seconds",
+            "per-transfer wall time quantiles by engine role",
+            {(("role", role),): summ for role, summ in kv_secs.items()},
+        ))
+
     # Sample-ring quantile gauges (legacy estimator; ms families in the
     # JSON snapshot stay seconds here — exposition is SI).
     fams.append(_summary_quantiles(

@@ -72,6 +72,10 @@ EVENT_KINDS = (
     "replica_lost",      # health-poll timeout / refusal / process exit
     "replica_restart",   # ReplicaSupervisor verdict -> replica relaunched
     "hot_swap",          # rolling checkpoint swap step (drain/restart/done)
+    # -- disaggregated serving (serve/disagg.py KV-page transfer) --
+    "kv_transfer_start",   # page-chain transfer admitted (role, bytes)
+    "kv_transfer_done",    # chain adopted by the decode role (bytes, s)
+    "kv_transfer_reject",  # budget shed / wire refusal (cause)
     "dump",
 )
 

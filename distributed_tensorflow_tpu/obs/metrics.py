@@ -364,6 +364,12 @@ class ServeMetrics:
         self.draft_tokens = Counter()
         self.accepted_tokens = Counter()
         self.spec_rejects = Counter()
+        # Disaggregated-serving (serve/disagg.py) families, keyed by role
+        # ("prefill"/"decode" — the side that sourced/adopted the chain):
+        # KV-page bytes moved between engine pools and the wall-clock
+        # seconds each transfer took (export + transport + adoption).
+        self.kv_transfer_bytes = LabelledCounter()
+        self.kv_transfer_seconds = LabelledHistogram()
         # ------------------------------------------------ windowed families
         # (obs/timeseries.py) — the SLO/health layer's inputs.  bad_w
         # counts requests that burned availability budget (backpressure +
@@ -465,6 +471,8 @@ class ServeMetrics:
             "draft_tokens": self.draft_tokens.value,
             "accepted_tokens": self.accepted_tokens.value,
             "spec_rejects": self.spec_rejects.value,
+            "kv_transfer_bytes": self.kv_transfer_bytes.snapshot(),
+            "kv_transfer_seconds": self.kv_transfer_seconds.snapshot(),
             "ttft_ms": {
                 k: (v * 1e3 if k != "count" else v)
                 for k, v in self.ttft.summary().items()

@@ -299,6 +299,115 @@ def plan_elastic_mesh(
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class DisaggPlan:
+    """A prefill/decode role split over one device slice (see
+    :func:`plan_disagg_mesh`). ``*_device_ids`` index into the caller's
+    device list (``jax.devices()`` order); ``*_axes`` feed straight into
+    :func:`build_mesh` together with the corresponding device subset.
+    ``fell_back`` means the roles share devices (colocated) because the
+    slice was too small to split; ``notes`` records every fallback taken,
+    in order."""
+
+    prefill_axes: dict[str, int]
+    decode_axes: dict[str, int]
+    prefill_device_ids: tuple[int, ...]
+    decode_device_ids: tuple[int, ...]
+    fell_back: bool = False
+    notes: tuple[str, ...] = ()
+
+
+def plan_disagg_mesh(
+    n_devices: int,
+    *,
+    prefill_devices: int = -1,
+    prefill_tp: int = 1,
+    decode_tp: int = 1,
+) -> DisaggPlan:
+    """Plan a prefill/decode engine-role split onto one device slice.
+
+    The serving twin of :func:`plan_elastic_mesh` and the inference rebirth
+    of the reference's ps/worker role split (SURVEY.md §1 L2–L3): prefill
+    is compute-bound and bursty, decode is memory-bound and steady, so a
+    disaggregated fleet plans them onto disjoint device subsets of the same
+    slice. Pure arithmetic — no jax import needed at plan time, so the
+    shardcheck SC002 sweep can cross it with every layout.
+
+    ``prefill_devices=-1`` means "half the slice, at least one device".
+    Degradation policy mirrors ``plan_elastic_mesh``: never refuse a
+    plannable topology, always note what was given up —
+
+    - a slice too small to split (``n_devices < 2``) falls back to
+      colocated roles sharing every device (``fell_back=True``);
+    - an explicit ``prefill_devices`` that would leave the decode role
+      empty is shrunk to leave at least one decode device;
+    - a role ``tp`` that does not divide its device count falls back to
+      the largest divisor that does (worst case 1).
+
+    Genuinely invalid inputs (``n_devices < 1``, non-positive explicit
+    ``prefill_devices``, non-positive tp) raise a clean ``ValueError`` —
+    the plan-or-clean-ValueError contract the SC002 sweep enforces.
+    """
+    if n_devices < 1:
+        raise ValueError(f"need at least one device, got {n_devices}")
+    if prefill_devices != -1 and prefill_devices < 1:
+        raise ValueError(
+            f"prefill_devices must be -1 (auto) or >= 1, got {prefill_devices}"
+        )
+    if prefill_tp < 1 or decode_tp < 1:
+        raise ValueError(
+            f"role tp must be >= 1, got prefill_tp={prefill_tp} "
+            f"decode_tp={decode_tp}"
+        )
+    notes: list[str] = []
+    if n_devices < 2:
+        notes.append(
+            "slice too small to split roles; colocating prefill and decode "
+            "on the same device"
+        )
+        ids = tuple(range(n_devices))
+        pre_ids, dec_ids, fell_back = ids, ids, True
+    else:
+        n_pre = prefill_devices if prefill_devices != -1 else n_devices // 2
+        if n_pre >= n_devices:
+            notes.append(
+                f"prefill_devices={n_pre} would leave no decode devices on "
+                f"a {n_devices}-device slice; shrinking to {n_devices - 1}"
+            )
+            n_pre = n_devices - 1
+        pre_ids = tuple(range(n_pre))
+        dec_ids = tuple(range(n_pre, n_devices))
+        fell_back = False
+
+    def _role_axes(role: str, tp: int, n: int) -> dict[str, int]:
+        if tp > 1 and (tp > n or n % tp):
+            new_tp = max(
+                d for d in range(1, min(tp, n) + 1) if tp % d == 0 and n % d == 0
+            )
+            notes.append(
+                f"{role} tp={tp} does not divide its {n} devices; falling "
+                f"back to tp={new_tp}"
+            )
+            tp = new_tp
+        axes = {"data": n // tp}
+        if tp > 1:
+            axes["model"] = tp
+        return axes
+
+    prefill_axes = _role_axes("prefill", prefill_tp, len(pre_ids))
+    decode_axes = _role_axes("decode", decode_tp, len(dec_ids))
+    for note in notes:
+        logger.warning("disagg role plan: %s", note)
+    return DisaggPlan(
+        prefill_axes=prefill_axes,
+        decode_axes=decode_axes,
+        prefill_device_ids=pre_ids,
+        decode_device_ids=dec_ids,
+        fell_back=fell_back,
+        notes=tuple(notes),
+    )
+
+
 # Short axis tags for layout labels, keyed by the canonical axis names.
 _AXIS_SHORT = {
     "replica": "rep",

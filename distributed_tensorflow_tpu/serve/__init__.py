@@ -22,6 +22,10 @@ training rather than a separate stack:
   (output stays bit-identical to plain decode).
 - ``server.py``  — in-process :class:`Client` plus a stdlib-HTTP front end
   with latency/queue/occupancy metrics (obs/metrics.py ServeMetrics).
+- ``disagg.py``  — disaggregated prefill/decode serving: engine roles on
+  device subsets, KV-page chain transfer (in-process device-to-device or
+  the versioned wire format over HTTP), and the bytes-in-flight transfer
+  budget in the admission path.
 
 Entry point: ``python -m distributed_tensorflow_tpu.cli.serve``.
 """
@@ -31,6 +35,15 @@ from distributed_tensorflow_tpu.serve.batcher import (  # noqa: F401
     BatcherConfig,
     ContinuousBatcher,
     DynamicBatcher,
+)
+from distributed_tensorflow_tpu.serve.disagg import (  # noqa: F401
+    DisaggServingPair,
+    TransferBudget,
+    WireError,
+    deserialize_chain,
+    make_kv_receiver,
+    post_kv_transfer,
+    serialize_chain,
 )
 from distributed_tensorflow_tpu.serve.engine import (  # noqa: F401
     BertInferenceEngine,

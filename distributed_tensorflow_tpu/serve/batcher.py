@@ -408,14 +408,34 @@ class DynamicBatcher:
             self.metrics.batches.inc()
             self.metrics.batch_occupancy.observe(len(batch))
             if not self._pipelined:
+                # The serial path runs its batch ON this thread, so without
+                # in-flight accounting a request could be inside the engine
+                # while both queue_depth and in_flight read 0 — drain
+                # probes (router hot-swap) had to demand two consecutive
+                # zero-work reads to close that blind spot. Count the
+                # running batch like the pipelined path does and the
+                # blind spot is gone.
+                with self._cv:
+                    self._n_inflight += 1
+                    self.metrics.in_flight.set(self._n_inflight)
                 try:
-                    results = self._run_batch([p.payload for p in batch])
-                except Exception as e:  # noqa: BLE001 — fail the batch, not the server
-                    self._fail(batch, e)
-                    continue
-                # Serial path: run_batch blocks through assemble + device +
-                # fetch, so the breakdown collapses to queue_wait -> run.
-                self._deliver(batch, results, final_phase="run")
+                    try:
+                        results = self._run_batch(
+                            [p.payload for p in batch]
+                        )
+                    except Exception as e:  # noqa: BLE001 — fail the batch, not the server
+                        self._fail(batch, e)
+                        continue
+                    # Serial path: run_batch blocks through assemble +
+                    # device + fetch, so the breakdown collapses to
+                    # queue_wait -> run.
+                    self._deliver(batch, results, final_phase="run")
+                finally:
+                    # Not decremented until futures resolve: a drain probe
+                    # reading zero must mean NOTHING is owed to a caller.
+                    with self._cv:
+                        self._n_inflight -= 1
+                        self.metrics.in_flight.set(self._n_inflight)
                 continue
             # Overlapped path: launch, hand off to the completion thread,
             # and immediately assemble the next batch. The semaphore
@@ -624,7 +644,7 @@ class ContinuousBatcher:
     _RACETRACE_ATTRS = (
         "_queue", "_count", "_closed", "_slots", "_n_active", "_n_inflight",
         "_steps", "_tokens_emitted", "_spec_drafted", "_spec_accepted",
-        "_spec_rejects",
+        "_spec_rejects", "_adoptions",
     )
 
     def __init__(
@@ -697,6 +717,11 @@ class ContinuousBatcher:
         self._gens = itertools.count(1)
         self._cv = threading.Condition()
         self._queue: deque[_Pending] = deque()
+        # Pending KV-chain adoptions (serve/disagg.py): processed on the
+        # decode-loop thread BETWEEN steps, because publishing a chain
+        # swaps the engine's pool refs — same single-dispatcher rule as
+        # every other engine touch.
+        self._adoptions: deque = deque()
         self._count = 0
         self._served = 0             # lifetime completed requests
         self._closed = False
@@ -761,6 +786,33 @@ class ContinuousBatcher:
             metrics.requests_w.add(1.0)
         self.recorder.record("request_admit", request_id)
         return pending.future
+
+    def adopt_chain(self, token_ids, pages_k=None, pages_v=None) -> Future:
+        """Adopt a transferred KV-page chain into this batcher's prefix
+        pool (serve/disagg.py decode role). Indexes ``token_ids``'s full
+        blocks in the pool and — when ``pages_*`` stages are given
+        (``[nl, max_chain, block_tokens, heads, head_dim]``, chain order)
+        — scatters the received pages into the newly allocated blocks via
+        the engine's AOT import cell. ``pages_* = None`` is the pool-only
+        form for engines whose prefill is position-independent (sim
+        engines; tests).
+
+        Runs on the decode-loop thread BETWEEN steps (the import swaps
+        the engine's pool refs, and the decode executable is never
+        touched — no per-token dispatch joins the hot path); this call
+        only enqueues and returns a Future resolving to the number of
+        newly imported blocks (0 = chain already fully cached)."""
+        fut: Future = Future()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._pool is None:
+                raise RuntimeError(
+                    "engine has no prefix cache to adopt a chain into"
+                )
+            self._adoptions.append((token_ids, pages_k, pages_v, fut))
+            self._cv.notify_all()
+        return fut
 
     def status(self) -> dict:
         metrics = self.metrics
@@ -886,7 +938,20 @@ class ContinuousBatcher:
                     and not self._queue
                     and self._n_active == 0
                 ):
+                    while self._adoptions:
+                        *_, fut = self._adoptions.popleft()
+                        if not fut.cancelled():
+                            fut.set_exception(
+                                RuntimeError("batcher closed")
+                            )
                     return None
+                # Chain adoptions drain first — a popped adoption's pool
+                # insert + page import runs before the NEXT pass's trie
+                # matches, so admissions planned after this pass can hit
+                # the transferred chain.
+                adopts = []
+                while self._adoptions:
+                    adopts.append(self._adoptions.popleft())
                 admissions = []
                 free = [
                     i for i, s in enumerate(self._slots) if s is None
@@ -1064,8 +1129,8 @@ class ContinuousBatcher:
                         if s.spec is not None:
                             s.spec.note_plain_step()  # probe clock
                     step = (lengths, active, temps, seeds, tags)
-                if admissions or chunk_rows or step or verify:
-                    return admissions, chunk_rows, step, verify
+                if admissions or chunk_rows or step or verify or adopts:
+                    return admissions, chunk_rows, step, verify, adopts
                 self._cv.wait()
 
     def _fail_slots(self, tagged: list[tuple[int, int]],
@@ -1118,7 +1183,28 @@ class ContinuousBatcher:
             if work is None:
                 self._completion.put(None)  # unblock the fetch thread
                 return
-            admissions, chunk_rows, step, verify = work
+            admissions, chunk_rows, step, verify, adopts = work
+            if adopts:
+                # Between-steps adoption (serve/disagg.py): index the
+                # chain in the pool, then scatter received pages into the
+                # freshly allocated blocks BEFORE anything else this pass
+                # dispatches — the import is in the stream ahead of any
+                # later chunk that could gather those blocks, so the
+                # kvpool publish-before-match contract holds.
+                for token_ids, pages_k, pages_v, fut in adopts:
+                    try:
+                        new = self._pool.insert(token_ids)
+                        if new and pages_k is not None:
+                            engine.import_prefix_pages(new, pages_k, pages_v)
+                        self.metrics.kv_pool_bytes.set(
+                            self._pool.stats()["bytes_used"]
+                        )
+                    except Exception as e:  # noqa: BLE001 — fail the adoption, not the loop
+                        if not fut.cancelled():
+                            fut.set_exception(e)
+                    else:
+                        if not fut.cancelled():
+                            fut.set_result(len(new))
             if self._plan_events:
                 # Backoff flips noted while planning (same thread, so no
                 # lock needed); recorded here, outside _cv.

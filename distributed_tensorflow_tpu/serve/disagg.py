@@ -1,0 +1,585 @@
+"""Disaggregated prefill/decode serving: engine roles + KV-page transfer.
+
+The production split the monolithic engine cannot express (ROADMAP item 2,
+PAPER.md §L2–L3 reborn for inference): prefill is compute-bound and
+bursty, decode is memory-bound and steady, so fleets run them on SEPARATE
+engine pools and hand the prompt's KV cache across. Everything here
+composes existing load-bearing pieces rather than adding a parallel
+universe:
+
+- a **prefill role** is an ordinary chunked ``CausalLMEngine`` +
+  ``ContinuousBatcher`` with a prefix cache: running a prompt to its
+  first token publishes the prompt's whole page chain into the role's
+  ``KVBlockPool`` (PR 12 machinery, unchanged);
+- **export** pins that chain (``pool.match``) and gathers its pages off
+  the pool (``engine.export_prefix_pages`` — copies, so the pin drops
+  right after dispatch, same stream-order argument as the chunk gather);
+- **transfer** is either in-process device-to-device (the gathered
+  device arrays flow straight into the decode engine's import scatter —
+  ``jax.device_put`` reshards across the role meshes) or the serialized
+  wire format below over the existing stdlib HTTP plumbing
+  (``POST /v1/kv_transfer``, octet-stream);
+- the **decode role** adopts via ``ContinuousBatcher.adopt_chain``:
+  pool-index the tokens, scatter received pages into the new blocks
+  BETWEEN decode steps on the loop thread — the decode executable is
+  never touched, so disaggregation adds zero per-token dispatch;
+- admission then re-prefills only the uncached tail, which is exactly a
+  prefix-cache hit — **bit-parity with colocated serving is inherited**
+  from PR 12's bit-exactness, not re-derived.
+
+An interconnect-aware :class:`TransferBudget` sits in the admission path:
+a bytes-in-flight cap queues (bounded, timed) or sheds transfers, sheds
+surfacing as 429 ``Backpressure`` with the budget digest in ``/statusz``.
+
+Role planning lives in ``parallel.mesh.plan_disagg_mesh`` (device-subset
+split + per-role mesh axes); the scheduler-policy A/B gate lives in
+``scripts/serve_bench.py --disagg``.
+
+Wire format (version 1)::
+
+    magic  b"KVPG"                      4 bytes
+    version                             u16 big-endian
+    header_len                          u32 big-endian
+    header JSON (utf-8), keys:
+        page_meta   {num_layers, block_tokens, heads, head_dim, dtype}
+        n_blocks    pages carried (chain order, lane i = block i)
+        token_ids   the FULL prompt ids (the decode pool re-derives its
+                    own block keys from them)
+        layout      axis-order tag ("lbthd" = layer,block,token,head,dim)
+        crc32       zlib.crc32 of the k+v payload bytes
+    k pages                             n_blocks contiguous C-order blocks
+    v pages                             same shape, immediately after
+
+Truncation, a bad magic, a version from the future, a geometry mismatch,
+or a payload CRC mismatch all raise :class:`WireError` — the receiver
+refuses rather than adopting garbage KV (tests/test_disagg.py pins each
+refusal).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from distributed_tensorflow_tpu.obs.flightrec import NULL_RECORDER
+from distributed_tensorflow_tpu.serve.batcher import Backpressure
+
+__all__ = [
+    "WireError",
+    "WIRE_VERSION",
+    "serialize_chain",
+    "deserialize_chain",
+    "TransferBudget",
+    "DisaggServingPair",
+    "make_kv_receiver",
+    "post_kv_transfer",
+]
+
+logger = logging.getLogger(__name__)
+
+WIRE_MAGIC = b"KVPG"
+WIRE_VERSION = 1
+_PREFIX = struct.Struct(">4sHI")  # magic, version, header_len
+_LAYOUT = "lbthd"
+
+
+class WireError(ValueError):
+    """A KV-page wire buffer the receiver must refuse (truncated, wrong
+    magic/version, geometry mismatch, corrupt payload)."""
+
+
+# ------------------------------------------------------------- wire format
+
+
+def serialize_chain(token_ids, pages_k, pages_v, page_meta: dict) -> bytes:
+    """Serialize a KV-page chain for the cross-process transport.
+
+    ``pages_*`` are host arrays ``[num_layers, n, block_tokens, heads,
+    head_dim]`` holding the chain's pages in order (NO pad lanes — the
+    caller slices its export stage down to the real chain length);
+    ``page_meta`` is the source engine's :meth:`page_meta` digest. The
+    token ids ride in the header so the receiving pool can index the
+    chain under its own trie without a side channel.
+    """
+    pk = np.ascontiguousarray(pages_k)
+    pv = np.ascontiguousarray(pages_v)
+    if pk.shape != pv.shape:
+        raise ValueError(f"k/v page shapes differ: {pk.shape} vs {pv.shape}")
+    if pk.ndim != 5:
+        raise ValueError(f"pages must be 5-D [l,b,t,h,d], got {pk.shape}")
+    if len(token_ids) // max(int(pk.shape[2]), 1) != pk.shape[1]:
+        raise ValueError(
+            f"{len(token_ids)} token keys do not cover exactly the "
+            f"{pk.shape[1]} pages carried (block_tokens={pk.shape[2]})"
+        )
+    payload = pk.tobytes() + pv.tobytes()
+    header = {
+        "page_meta": {
+            "num_layers": int(pk.shape[0]),
+            "block_tokens": int(pk.shape[2]),
+            "heads": int(pk.shape[3]),
+            "head_dim": int(pk.shape[4]),
+            "dtype": str(pk.dtype.name),
+        },
+        "n_blocks": int(pk.shape[1]),
+        "token_ids": [int(t) for t in token_ids],
+        "layout": _LAYOUT,
+        "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+    }
+    expect = {k: v for k, v in page_meta.items() if k != "max_chain"}
+    got = dict(header["page_meta"])
+    if expect != got:
+        raise ValueError(
+            f"pages {got} disagree with the engine's page_meta {expect}"
+        )
+    hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return _PREFIX.pack(WIRE_MAGIC, WIRE_VERSION, len(hbytes)) + hbytes + payload
+
+
+def deserialize_chain(buf: bytes):
+    """Parse + verify a wire buffer: returns ``(token_ids, pages_k,
+    pages_v, header)`` with host-numpy page stages. Every malformation
+    raises :class:`WireError` BEFORE any page bytes are trusted."""
+    if len(buf) < _PREFIX.size:
+        raise WireError(
+            f"buffer of {len(buf)} bytes is shorter than the "
+            f"{_PREFIX.size}-byte wire prefix"
+        )
+    magic, version, hlen = _PREFIX.unpack_from(buf)
+    if magic != WIRE_MAGIC:
+        raise WireError(f"bad magic {magic!r} (expected {WIRE_MAGIC!r})")
+    if version != WIRE_VERSION:
+        raise WireError(
+            f"wire version {version} unsupported (speaker of version "
+            f"{WIRE_VERSION}); refusing rather than guessing the layout"
+        )
+    if len(buf) < _PREFIX.size + hlen:
+        raise WireError(
+            f"truncated header: need {hlen} bytes, have "
+            f"{len(buf) - _PREFIX.size}"
+        )
+    try:
+        header = json.loads(buf[_PREFIX.size:_PREFIX.size + hlen])
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise WireError(f"corrupt header JSON: {e}") from e
+    try:
+        meta = header["page_meta"]
+        shape = (
+            int(meta["num_layers"]), int(header["n_blocks"]),
+            int(meta["block_tokens"]), int(meta["heads"]),
+            int(meta["head_dim"]),
+        )
+        dtype = np.dtype(meta["dtype"])
+        token_ids = [int(t) for t in header["token_ids"]]
+        layout = header["layout"]
+        crc = int(header["crc32"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"header missing/invalid field: {e}") from e
+    if layout != _LAYOUT:
+        raise WireError(
+            f"page layout {layout!r} unsupported (expected {_LAYOUT!r})"
+        )
+    if len(token_ids) // max(int(meta["block_tokens"]), 1) != shape[1]:
+        raise WireError(
+            f"{len(token_ids)} token keys cover "
+            f"{len(token_ids) // max(int(meta['block_tokens']), 1)} blocks "
+            f"but the buffer carries {shape[1]} pages — a receiving pool "
+            "would index blocks whose pages never arrived"
+        )
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    payload = buf[_PREFIX.size + hlen:]
+    if len(payload) != 2 * nbytes:
+        raise WireError(
+            f"payload of {len(payload)} bytes != 2 x {nbytes} "
+            f"for {shape} {dtype.name} pages"
+        )
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireError("payload CRC mismatch: pages corrupted in flight")
+    pages_k = np.frombuffer(payload[:nbytes], dtype).reshape(shape)
+    pages_v = np.frombuffer(payload[nbytes:], dtype).reshape(shape)
+    return token_ids, pages_k, pages_v, header
+
+
+# --------------------------------------------------------- transfer budget
+
+
+class TransferBudget:
+    """Interconnect-aware bytes-in-flight cap for KV-page transfers.
+
+    The admission-path guard: a transfer :meth:`acquire`\\ s its byte
+    count before moving anything. Over the cap it WAITS (bounded queue,
+    bounded time — interconnects recover in milliseconds, admission
+    shouldn't shed on a blip); a full waiter queue or a timeout SHEDS as
+    :class:`~.batcher.Backpressure` (the server maps it to 429 +
+    Retry-After, same as queue sheds). ``digest()`` feeds ``/statusz``.
+    """
+
+    def __init__(self, cap_bytes: int, *, max_queued: int = 8,
+                 timeout_s: float = 2.0):
+        if cap_bytes < 1:
+            raise ValueError(f"cap_bytes must be >= 1, got {cap_bytes}")
+        self.cap_bytes = int(cap_bytes)
+        self.max_queued = int(max_queued)
+        self.timeout_s = float(timeout_s)
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self._queued = 0
+        self._granted = 0
+        self._shed = 0
+
+    def acquire(self, nbytes: int) -> None:
+        """Reserve ``nbytes`` of transfer headroom or raise
+        ``Backpressure``. A single transfer larger than the whole cap can
+        never fit and sheds immediately."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        deadline = time.monotonic() + self.timeout_s
+        with self._cv:
+            if nbytes > self.cap_bytes or self._queued >= self.max_queued:
+                self._shed += 1
+                raise Backpressure(self.timeout_s)
+            self._queued += 1
+            try:
+                while self._in_flight + nbytes > self.cap_bytes:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        self._shed += 1
+                        raise Backpressure(self.timeout_s)
+            finally:
+                self._queued -= 1
+            self._in_flight += nbytes
+            self._granted += 1
+
+    def release(self, nbytes: int) -> None:
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        with self._cv:
+            self._in_flight = max(self._in_flight - nbytes, 0)
+            self._cv.notify_all()
+
+    def digest(self) -> dict:
+        """The ``/statusz`` ``kv_transfer`` section."""
+        with self._cv:
+            return {
+                "cap_bytes": self.cap_bytes,
+                "in_flight_bytes": self._in_flight,
+                "queued": self._queued,
+                "granted_total": self._granted,
+                "shed_total": self._shed,
+            }
+
+
+# --------------------------------------------------------- role orchestration
+
+
+class DisaggServingPair:
+    """One prefill role + one decode role behind a single submit surface.
+
+    Both roles are ordinary engine+batcher stacks (built on the device
+    subsets a :func:`~distributed_tensorflow_tpu.parallel.mesh.plan_disagg_mesh`
+    planned, or sim engines in the bench); the pair owns only the
+    hand-off: run the prompt on the prefill role to its first token,
+    move the published page chain under the transfer budget, adopt it on
+    the decode role, then submit the UNCHANGED request there — the
+    decode role's admission re-prefills just the uncached tail, so the
+    stream is bit-identical to a colocated engine's by the prefix-cache
+    parity contract.
+
+    ``transport="d2d"`` hands the gathered device pages straight to the
+    decode engine's import scatter (same process, different device
+    subsets); ``transport="wire"`` round-trips the serialized format —
+    in-process it is the loopback rehearsal of the cross-process path
+    (the bench's parity arm), cross-process the caller POSTs the buffer
+    via :func:`post_kv_transfer` instead of constructing a pair.
+
+    Engines without page export (sim engines) degrade to pool-only
+    adoption: the chain is indexed on the decode pool with no page
+    scatter, which is exact for sims whose prefill is a pure function of
+    the full prompt.
+    """
+
+    def __init__(
+        self,
+        *,
+        prefill_batcher,
+        decode_batcher,
+        prefill_engine=None,
+        decode_engine=None,
+        budget: TransferBudget | None = None,
+        transport: str = "d2d",
+        metrics=None,
+        recorder=None,
+    ):
+        if transport not in ("d2d", "wire"):
+            raise ValueError(
+                f"transport must be 'd2d' or 'wire', got {transport!r}"
+            )
+        self.prefill = prefill_batcher
+        self.decode = decode_batcher
+        self._pre_engine = prefill_engine
+        self._dec_engine = decode_engine
+        self.budget = budget
+        self.transport = transport
+        self.metrics = metrics
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        self._pre_pool = getattr(
+            prefill_engine, "prefix_cache", None
+        ) or getattr(prefill_batcher, "_pool", None)
+        if self._pre_pool is None:
+            raise ValueError(
+                "prefill role needs a prefix cache (its pool IS the "
+                "publication surface a transfer exports from)"
+            )
+        if prefill_engine is not None and decode_engine is not None and (
+            callable(getattr(prefill_engine, "export_prefix_pages", None))
+        ):
+            pm = prefill_engine.page_meta()
+            dm = decode_engine.page_meta()
+            if pm != dm:
+                raise ValueError(
+                    f"role page geometries differ: prefill {pm} vs "
+                    f"decode {dm} — chains cannot transfer"
+                )
+
+    # ------------------------------------------------------------ transfer
+
+    def transfer(self, token_ids, request_id: str = "") -> int:
+        """Move ``token_ids``'s published chain from the prefill pool to
+        the decode role; returns the number of blocks the decode side
+        newly adopted (0 = nothing published or already cached). Budget
+        sheds raise ``Backpressure`` (recorded as ``kv_transfer_reject``);
+        transfer itself records start/done events plus the role-labelled
+        byte/latency families."""
+        pool = self._pre_pool
+        m = pool.match(token_ids)
+        try:
+            if not m.blocks:
+                return 0
+            # The decode pool must never index a block whose pages were
+            # not carried: trim the token keys to EXACTLY the matched
+            # chain's coverage, so its insert allocates n_blocks blocks
+            # and not one more (the uncovered tail re-prefills there).
+            token_ids = [
+                int(t)
+                for t in token_ids[: len(m.blocks) * pool.block_tokens]
+            ]
+            nbytes = len(m.blocks) * pool.bytes_per_block
+            if self.budget is not None:
+                try:
+                    self.budget.acquire(nbytes)
+                except Backpressure:
+                    self.recorder.record(
+                        "kv_transfer_reject", request_id,
+                        cause="budget", bytes=nbytes,
+                    )
+                    raise
+            try:
+                t0 = time.monotonic()
+                self.recorder.record(
+                    "kv_transfer_start", request_id,
+                    blocks=len(m.blocks), bytes=nbytes,
+                    transport=self.transport,
+                )
+                adopted = self._move(token_ids, m.blocks)
+                dt = time.monotonic() - t0
+            finally:
+                if self.budget is not None:
+                    self.budget.release(nbytes)
+            if self.metrics is not None:
+                self.metrics.kv_transfer_bytes.inc("prefill", nbytes)
+                self.metrics.kv_transfer_bytes.inc("decode", nbytes)
+                self.metrics.kv_transfer_seconds.observe("prefill", dt)
+                self.metrics.kv_transfer_seconds.observe("decode", dt)
+            self.recorder.record(
+                "kv_transfer_done", request_id,
+                blocks=len(m.blocks), adopted=adopted, bytes=nbytes,
+                ms=round(dt * 1e3, 3),
+            )
+            return adopted
+        finally:
+            pool.release(m)  # idempotent; pin held across the export
+
+    def _move(self, token_ids, blocks) -> int:
+        engine = self._pre_engine
+        if engine is None or not callable(
+            getattr(engine, "export_prefix_pages", None)
+        ):
+            # Sim / pool-only roles: index the chain, no pages to carry.
+            return self.decode.adopt_chain(token_ids).result()
+        pk, pv = engine.export_prefix_pages(blocks)
+        if self.transport == "wire":
+            # Loopback rehearsal of the cross-process path: fetch, frame,
+            # parse, verify — byte-for-byte what POST /v1/kv_transfer
+            # carries. device_get here is off the decode loop (this
+            # module is not a jaxlint hot module) and overlaps both
+            # roles' device work.
+            import jax
+
+            n = len(blocks)
+            buf = serialize_chain(
+                token_ids,
+                np.asarray(jax.device_get(pk))[:, :n],
+                np.asarray(jax.device_get(pv))[:, :n],
+                engine.page_meta(),
+            )
+            ids, wk, wv, _ = deserialize_chain(buf)
+            m = self._dec_engine.page_meta()["max_chain"]
+            return self.decode.adopt_chain(
+                ids, _pad_chain(wk, m), _pad_chain(wv, m)
+            ).result()
+        # d2d: gathered device stages flow straight into the decode
+        # engine's import scatter (device_put reshards across role
+        # meshes; no host round-trip).
+        return self.decode.adopt_chain(token_ids, pk, pv).result()
+
+    # ------------------------------------------------------------- serving
+
+    def submit(self, payload: dict, request_id: str | None = None):
+        """Disaggregated serve of one request: prefill role to first
+        token, chain transfer, decode role for the real stream. Blocks
+        through prefill + transfer (callers thread per request, as the
+        bench does); returns the decode role's Future — the stream it
+        resolves to is bit-identical to a colocated engine's."""
+        pre_payload = dict(payload)
+        pre_payload["max_new_tokens"] = 1
+        self.prefill.submit(pre_payload, request_id=request_id).result()
+        try:
+            self.transfer(
+                payload["input_ids"],
+                request_id=request_id or "",
+            )
+        except Backpressure:
+            # Budget shed: the request still serves, just without the
+            # chain — the decode role re-prefills the whole prompt.
+            # Degraded latency, never a failed request.
+            pass
+        return self.decode.submit(payload, request_id=request_id)
+
+    def generate(self, payload: dict, request_id: str | None = None):
+        """Blocking convenience: :meth:`submit` + result."""
+        return self.submit(payload, request_id=request_id).result()
+
+    def close(self, drain: bool = True) -> None:
+        self.prefill.close(drain=drain)
+        self.decode.close(drain=drain)
+
+
+def _pad_chain(pages: np.ndarray, max_chain: int) -> np.ndarray:
+    """Pad a ``[l, n, t, h, d]`` chain stage to the import cell's fixed
+    ``max_chain`` lanes (pad lanes are dropped by sentinel ids)."""
+    n = pages.shape[1]
+    if n > max_chain:
+        raise WireError(
+            f"chain of {n} blocks exceeds the importer's max chain "
+            f"{max_chain}"
+        )
+    if n == max_chain:
+        return pages
+    pad = np.zeros(
+        (pages.shape[0], max_chain - n, *pages.shape[2:]), pages.dtype
+    )
+    return np.concatenate([pages, pad], axis=1)
+
+
+# ------------------------------------------------------- cross-process wire
+
+
+def make_kv_receiver(batcher, engine, *, budget: TransferBudget | None = None,
+                     metrics=None, recorder=None):
+    """The decode-process half of the cross-process transport: a
+    ``bytes -> dict`` callable the HTTP server mounts at
+    ``POST /v1/kv_transfer``. Verifies the wire buffer, checks geometry
+    against the local engine, budget-gates the bytes, and adopts via the
+    batcher (loop-thread import, like every adoption). Raises
+    ``WireError`` (400) on refusal, ``Backpressure`` (429) on shed."""
+    recorder = recorder if recorder is not None else NULL_RECORDER
+
+    def receive(body: bytes) -> dict:
+        try:
+            token_ids, pk, pv, header = deserialize_chain(body)
+        except WireError as e:
+            recorder.record("kv_transfer_reject", "", cause="wire",
+                            error=str(e))
+            raise
+        meta = engine.page_meta()
+        got = dict(header["page_meta"])
+        expect = {k: v for k, v in meta.items() if k != "max_chain"}
+        if got != expect:
+            recorder.record("kv_transfer_reject", "", cause="geometry")
+            raise WireError(
+                f"page geometry {got} does not match this engine's "
+                f"{expect}"
+            )
+        nbytes = len(body)
+        if budget is not None:
+            try:
+                budget.acquire(nbytes)
+            except Backpressure:
+                recorder.record("kv_transfer_reject", "", cause="budget",
+                                bytes=nbytes)
+                raise
+        try:
+            t0 = time.monotonic()
+            recorder.record("kv_transfer_start", "", blocks=pk.shape[1],
+                            bytes=nbytes, transport="wire")
+            adopted = batcher.adopt_chain(
+                token_ids,
+                _pad_chain(pk, meta["max_chain"]),
+                _pad_chain(pv, meta["max_chain"]),
+            ).result()
+            dt = time.monotonic() - t0
+        finally:
+            if budget is not None:
+                budget.release(nbytes)
+        if metrics is not None:
+            metrics.kv_transfer_bytes.inc("decode", nbytes)
+            metrics.kv_transfer_seconds.observe("decode", dt)
+        recorder.record("kv_transfer_done", "", blocks=pk.shape[1],
+                        adopted=adopted, bytes=nbytes,
+                        ms=round(dt * 1e3, 3))
+        return {"adopted_blocks": adopted, "bytes": nbytes}
+
+    return receive
+
+
+def post_kv_transfer(host: str, port: int, buf: bytes, *,
+                     timeout_s: float = 10.0) -> dict:
+    """Prefill-process half of the cross-process transport: POST a
+    serialized chain to a decode server's ``/v1/kv_transfer``. Returns
+    the adoption digest; raises ``Backpressure`` on a 429 shed and
+    ``WireError`` on a 400 refusal (mirroring the in-process paths)."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request(
+            "POST", "/v1/kv_transfer", body=buf,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        resp = conn.getresponse()
+        body = resp.read()
+        try:
+            out = json.loads(body)
+        except json.JSONDecodeError:
+            out = {"error": body[:200].decode("utf-8", "replace")}
+        if resp.status == 429:
+            raise Backpressure(
+                float(resp.headers.get("Retry-After", 1.0))
+            )
+        if resp.status == 400:
+            raise WireError(out.get("error", "kv transfer refused"))
+        if resp.status != 200:
+            raise RuntimeError(
+                f"kv transfer failed: HTTP {resp.status} {out}"
+            )
+        return out
+    finally:
+        conn.close()

@@ -865,6 +865,42 @@ def _make_prefix_insert(block_tokens: int):
     return insert_fn
 
 
+def _make_pool_export():
+    """Gather-for-transfer executable body (serve/disagg.py): read a
+    pinned chain's pages out of the prefix pool into a fixed ``[nl,
+    max_chain, block_tokens, heads, head_dim]`` stage (pad lanes repeat
+    block 0; the importer's sentinel ids drop them). The pool operands
+    are NOT donated — export copies, the pool stays live, and the
+    caller's ``KVBlockPool.match`` pin keeps the gathered blocks
+    immutable for the duration."""
+
+    def export_fn(pool_k, pool_v, block_ids):
+        return (
+            jnp.take(pool_k, block_ids, axis=1),
+            jnp.take(pool_v, block_ids, axis=1),
+        )
+
+    return export_fn
+
+
+def _make_pool_import():
+    """Adopt-transferred-pages executable body (serve/disagg.py): scatter
+    a fixed ``[nl, max_chain, block_tokens, heads, head_dim]`` stage of
+    received KV pages into the prefix pool at ``block_ids`` (padded with
+    the out-of-pool sentinel, whose scatters drop — pad lanes carry
+    garbage pages that never land). The pool operands are DONATED like
+    every other executable in the chain; the import dispatches between
+    decode steps on the loop thread, so the decode executable itself is
+    untouched."""
+
+    def import_fn(pool_k, pool_v, pages_k, pages_v, block_ids):
+        pool_k = pool_k.at[:, block_ids].set(pages_k, mode="drop")
+        pool_v = pool_v.at[:, block_ids].set(pages_v, mode="drop")
+        return pool_k, pool_v
+
+    return import_fn
+
+
 class CausalLMEngine(_AotEngine):
     """Autoregressive generation over a trained :class:`CausalLM` checkpoint
     with a paged, slot-addressed KV cache.
@@ -942,6 +978,7 @@ class CausalLMEngine(_AotEngine):
         spec_tokens: int = 0,
         spec_min_match: int = 2,
         spec_backoff: float = 0.25,
+        kv_transfer: bool = False,
         memory=None,
     ):
         if slots < 1:
@@ -1077,6 +1114,9 @@ class CausalLMEngine(_AotEngine):
         # engine swaps its refs for the returned ones at dispatch.
         self._prefill_compiled = {}
         self._chunk_compiled = {}
+        self._export_compiled = None
+        self._import_compiled = None
+        self._kv_transfer = False
         n_spec_cells = 1 if self.spec_tokens else 0
         if not self._chunked_mode:
             self._plan_cells(
@@ -1105,9 +1145,13 @@ class CausalLMEngine(_AotEngine):
                         ),
                     )
         else:
+            self._kv_transfer = (
+                bool(kv_transfer) and self.prefix_cache is not None
+            )
             self._plan_cells(
                 len(self.batch_tiers) * len(self._chunk_buckets) + 1
-                + (1 if self.prefix_cache is not None else 0) + n_spec_cells
+                + (1 if self.prefix_cache is not None else 0)
+                + (2 if self._kv_transfer else 0) + n_spec_cells
             )
             chunk_fn = self._wrap_chunk(
                 _make_causal_chunk_prefill(self.model, self.cache_len)
@@ -1154,6 +1198,44 @@ class CausalLMEngine(_AotEngine):
                             self._cache_struct(cache_shape, cfg.dtype),
                             self._rep_struct((), jnp.int32),
                             self._rep_struct((self._max_chain,), jnp.int32),
+                            self._rep_struct((self._max_chain,), jnp.int32),
+                        )
+                        .compile()
+                    ),
+                )
+            if self._kv_transfer:
+                pages_struct = self._cache_struct(
+                    (cfg.num_layers, self._max_chain, self.block_tokens,
+                     *pool_shape[3:]),
+                    cfg.dtype,
+                )
+                # Export gathers pinned pages OUT of the pool — the pool
+                # operands are NOT donated (they must survive the gather;
+                # eager ops over the donation-aliased pool are exactly
+                # what this AOT cell exists to avoid).
+                export_fn = self._wrap_export(_make_pool_export())
+                self._export_compiled = self._compile_cell(
+                    f"lm/{self.layout}/export",
+                    lambda: (
+                        jax.jit(export_fn)
+                        .lower(
+                            pool_struct,
+                            pool_struct,
+                            self._rep_struct((self._max_chain,), jnp.int32),
+                        )
+                        .compile()
+                    ),
+                )
+                import_fn = self._wrap_import(_make_pool_import())
+                self._import_compiled = self._compile_cell(
+                    f"lm/{self.layout}/import",
+                    lambda: (
+                        jax.jit(import_fn, donate_argnums=(0, 1))
+                        .lower(
+                            pool_struct,
+                            pool_struct,
+                            pages_struct,
+                            pages_struct,
                             self._rep_struct((self._max_chain,), jnp.int32),
                         )
                         .compile()
@@ -1216,7 +1298,8 @@ class CausalLMEngine(_AotEngine):
             self.prefix_cache.n_blocks if self.prefix_cache else None,
             self.spec_tokens or None,
             len(self._prefill_compiled) + len(self._chunk_compiled) + 1
-            + (1 if self.prefix_cache is not None else 0) + n_spec_cells,
+            + (1 if self.prefix_cache is not None else 0)
+            + (2 if self._kv_transfer else 0) + n_spec_cells,
         )
 
     @staticmethod
@@ -1367,6 +1450,34 @@ class CausalLMEngine(_AotEngine):
             mesh=self.mesh,
             in_specs=(cache, cache, cache, cache, rep, rep, rep),
             out_specs=(cache, cache, cache, cache),
+            check_vma=False,
+        )
+
+    def _wrap_import(self, fn):
+        """Pool-import twin of ``_wrap_insert``: transferred pages shard
+        their head axis exactly like the pool they scatter into."""
+        if not self._model_sharded:
+            return fn
+        cache, rep = self._cache_spec, P()
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(cache, cache, cache, cache, rep),
+            out_specs=(cache, cache),
+            check_vma=False,
+        )
+
+    def _wrap_export(self, fn):
+        """Pool-export twin of ``_wrap_import``: per-shard gathers stay
+        local (the page stage splits its head axis like the pool)."""
+        if not self._model_sharded:
+            return fn
+        cache, rep = self._cache_spec, P()
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(cache, cache, rep),
+            out_specs=(cache, cache),
             check_vma=False,
         )
 
@@ -1589,6 +1700,109 @@ class CausalLMEngine(_AotEngine):
         )
         self._pool_k, self._pool_v = pk, pv
         self._cache_k, self._cache_v = ck, cv
+
+    # -- disaggregated-serving page transfer (serve/disagg.py) ----------
+
+    def export_prefix_pages(self, blocks: list[int]):
+        """Gather published pool pages for a PINNED chain of block ids:
+        returns device arrays ``[nl, max_chain, block_tokens, heads,
+        head_dim]`` (k, v) — the chain's pages in order, pad lanes
+        repeating block 0 (the importer's sentinel ids drop them).
+        Requires ``kv_transfer=True`` at construction (the AOT export
+        cell — same no-trace rule as every other dispatch).
+
+        Safe OFF the decode-loop thread, unlike every dispatch method: it
+        never swaps the engine's device-state refs, and the caller holds
+        a ``KVBlockPool.match`` pin, so the gathered blocks hold the
+        prompt's bytes for the duration. The one cross-thread hazard is
+        the pool ref itself: a concurrent publish DONATES the buffer this
+        thread just read, and a dispatch that loses that race raises
+        jax's deleted-array error — re-read the swapped-in ref and
+        retry (bounded; the pin means any ref's content is equally
+        correct)."""
+        if self._export_compiled is None:
+            raise RuntimeError(
+                "engine built without kv_transfer=True (no pool-export "
+                "cell)"
+            )
+        M = self._max_chain
+        if len(blocks) > M:
+            raise ValueError(
+                f"exporting {len(blocks)} blocks exceeds max chain {M}"
+            )
+        idx = np.zeros((M,), np.int32)
+        idx[: len(blocks)] = blocks
+        jdx = jax.device_put(idx, self._rep)
+        for attempt in range(5):
+            pk, pv = self._pool_k, self._pool_v
+            try:
+                return self._export_compiled(pk, pv, jdx)
+            # jax surfaces the dead-buffer dispatch as RuntimeError from
+            # the python call path and ValueError (INVALID_ARGUMENT) from
+            # the C++ fast path — match the message, not the type.
+            except (RuntimeError, ValueError) as e:
+                dead = "deleted" in str(e) or "donated" in str(e)
+                if not dead or attempt == 4:
+                    raise
+                # A publish is mid-swap on the loop thread: the donation
+                # lands before the ref swap, so an immediate re-read can
+                # still see the dead ref. Back off past the swap window.
+                time.sleep(0.002 * (attempt + 1))
+        raise AssertionError("unreachable")
+
+    def import_prefix_pages(
+        self, blocks: list[tuple[int, int]], pages_k, pages_v
+    ) -> None:
+        """Adopt transferred KV pages into this engine's prefix pool:
+        ``blocks`` are ``(block_id, chain_index)`` pairs from
+        ``KVBlockPool.insert`` on THIS engine's pool — chain_index picks
+        the page lane out of the received stage (a chain partially cached
+        here imports only its new blocks); ``pages_*`` are ``[nl,
+        max_chain, block_tokens, heads, head_dim]`` stages (host numpy
+        from the wire path, or device arrays from the D2D path).
+        Decode-loop thread only — it swaps the pool refs, like
+        ``insert_prefix``; dispatch-only, nothing to fetch. Requires
+        ``kv_transfer=True`` at construction (the AOT import cell)."""
+        if self._import_compiled is None:
+            raise RuntimeError(
+                "engine built without kv_transfer=True (no pool-import "
+                "cell)"
+            )
+        M = self._max_chain
+        if len(blocks) > M:
+            raise ValueError(
+                f"importing {len(blocks)} blocks exceeds max chain {M}"
+            )
+        ids = np.full((M,), self._pool_blocks, np.int32)  # sentinel: drop
+        for bid, cix in blocks:
+            if not 0 <= int(cix) < M:
+                raise ValueError(
+                    f"chain index {cix} outside the {M}-lane page stage"
+                )
+            ids[int(cix)] = int(bid)
+        pk, pv = self._import_compiled(
+            self._pool_k, self._pool_v,
+            jax.device_put(pages_k, self._cache_sharding),
+            jax.device_put(pages_v, self._cache_sharding),
+            jax.device_put(ids, self._rep),
+        )
+        self._pool_k, self._pool_v = pk, pv
+
+    def page_meta(self) -> dict:
+        """Static page-geometry digest the wire format stamps into its
+        header (serve/disagg.py) — two pools are transfer-compatible iff
+        these match."""
+        if self.prefix_cache is None:
+            raise RuntimeError("engine has no prefix cache")
+        nl, _, bt, heads, hd = self._pool_k.shape
+        return {
+            "num_layers": int(nl),
+            "block_tokens": int(bt),
+            "heads": int(heads),
+            "head_dim": int(hd),
+            "dtype": str(np.dtype(self._pool_k.dtype).name),
+            "max_chain": int(self._max_chain),
+        }
 
     def decode(self, lengths, active, temps, seeds) -> InFlightBatch:
         """Dispatch ONE decode step over the full slot table (host arrays

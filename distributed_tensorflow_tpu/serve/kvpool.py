@@ -136,6 +136,26 @@ class KVBlockPool:
                 [n.block for n in nodes], len(nodes) * bt, nodes
             )
 
+    def cached_len(self, token_ids) -> int:
+        """No-pin peek: tokens of ``token_ids`` covered by cached blocks,
+        under the same one-token-suffix cap as :meth:`match`. Advisory
+        only (the answer can change the moment the lock drops) — the
+        disagg transfer planner uses it to size the uncached remainder a
+        wire push must carry; admission still does a real pinning
+        :meth:`match`."""
+        ids = [int(t) for t in token_ids]
+        bt = self.block_tokens
+        limit = max(len(ids) - 1, 0) // bt
+        with self._lock:
+            node, n = self._root, 0
+            for b in range(limit):
+                child = node.children.get(tuple(ids[b * bt:(b + 1) * bt]))
+                if child is None:
+                    break
+                n += 1
+                node = child
+            return n * bt
+
     def release(self, match: PrefixMatch) -> None:
         """Unpin a matched chain (idempotent)."""
         with self._lock:

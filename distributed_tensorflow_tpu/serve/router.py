@@ -618,22 +618,18 @@ class Router:
 
     def _wait_drained(self, r: Replica, deadline: float) -> bool:
         """Poll the draining replica until queued + in-flight work hits
-        zero (its 503 health body still carries the batcher status).
-        The zero must hold on two consecutive probes: the serial flush
-        path runs its batch ON the flusher thread, where a request can be
-        inside the engine without showing in either counter."""
-        zeros = 0
+        zero (its 503 health body still carries the batcher status). One
+        zero probe suffices: every flush path — including the serial one,
+        which runs its batch ON the flusher thread — counts a running
+        batch in ``in_flight`` until its futures resolve, so a zero read
+        means nothing is queued, dispatched, or owed to a caller."""
         while self._clock() < deadline:
             alive, body = self._probe(r)
             if alive and (
                 body.get("queue_depth", 0) + body.get("in_flight", 0)
                 + body.get("slots_active", 0)
             ) == 0:
-                zeros += 1
-                if zeros >= 2:
-                    return True
-            else:
-                zeros = 0
+                return True
             time.sleep(0.05)
         return False
 

@@ -310,6 +310,8 @@ def build_http_server(
     port: int = 0,
     *,
     trace_dir: str | None = None,
+    kv_receiver=None,
+    transfer_budget=None,
 ):
     """Build (not start) a ``ThreadingHTTPServer`` over ``client``.
 
@@ -317,6 +319,13 @@ def build_http_server(
     Call ``serve_forever()`` to run; ``shutdown()`` to stop. ``trace_dir``
     is where ``POST /profilez`` drops its ``jax.profiler`` captures (the
     endpoint answers 503 without one).
+
+    Disaggregated decode roles pass ``kv_receiver`` (a ``bytes -> dict``
+    callable from :func:`~distributed_tensorflow_tpu.serve.disagg.make_kv_receiver`)
+    to mount ``POST /v1/kv_transfer`` — octet-stream wire buffers, 400 on
+    a ``WireError`` refusal, 429 on a budget shed — and ``transfer_budget``
+    (a :class:`~distributed_tensorflow_tpu.serve.disagg.TransferBudget`)
+    to surface the bytes-in-flight digest under ``/statusz``.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -383,6 +392,10 @@ def build_http_server(
                     if k != "cells"
                 },
                 "flight_recorder": client.recorder.status(),
+                **(
+                    {"kv_transfer": transfer_budget.digest()}
+                    if transfer_budget is not None else {}
+                ),
             }
 
         def do_GET(self):
@@ -460,6 +473,36 @@ def build_http_server(
             url = urlparse(self.path)
             if url.path == "/profilez":
                 self._profilez(url)
+                return
+            if url.path == "/v1/kv_transfer":
+                if kv_receiver is None:
+                    self._reply(
+                        503,
+                        {"error": "kv transfer disabled: server built "
+                                  "without a receiver (decode role only)"},
+                    )
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    out = kv_receiver(self.rfile.read(n))
+                except ValueError as e:  # WireError: refuse, don't adopt
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — budget shed or adoption failure
+                    retry = getattr(e, "retry_after_s", None)
+                    if retry is not None:
+                        self._reply(
+                            429,
+                            {"error": str(e), "retry_after_s": retry},
+                            headers={"Retry-After": f"{retry:.3f}"},
+                        )
+                    else:
+                        logger.exception("kv transfer failed")
+                        client.recorder.record(
+                            "server_error", "", error=type(e).__name__,
+                        )
+                        self._reply(500, {"error": str(e)})
+                else:
+                    self._reply(200, out)
                 return
             if url.path == "/drainz":
                 client.start_draining()
