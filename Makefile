@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick disagg-quick chaos-quick fleet-quick
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick disagg-quick chaos-quick fleet-quick migrate-quick
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -64,6 +64,17 @@ fleet-quick:
 	$(PY) -m pytest tests/test_router.py -q
 	$(PY) -m pytest tests/test_router.py -q -m slow
 	$(PY) scripts/serve_bench.py --fleet --quick
+
+# Live stream-migration gate (~3 min): the stream-wire/fault-plan/
+# export-adopt-parity unit suite, then the serve_bench --migrate drills —
+# kill a replica right after its streams migrate (every generation
+# resolves bit-identical via stream_wait or a resume_tokens replay) and
+# drain-via-migration vs drain-and-wait under long-generation pacing
+# (the migrated drain must free its victim strictly faster; parity and
+# zero lost/duplicated streams gate unconditionally).
+migrate-quick:
+	$(PY) -m pytest tests/test_migrate.py -q
+	$(PY) scripts/serve_bench.py --migrate --quick
 
 # Static analysis + config sweep over the package; nonzero exit on any
 # non-baselined finding or stale baseline entry.

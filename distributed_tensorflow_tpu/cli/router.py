@@ -74,6 +74,12 @@ def main(argv: list[str] | None = None):
                         help="router flight-recorder ring capacity")
     parser.add_argument("--dump-dir", default="",
                         help="router flight-recorder dump directory")
+    parser.add_argument("--fault-plan", default="",
+                        help="router-side fault plan (chaos drills): "
+                        "'seed=..,probe_timeout=N' drops the Nth health "
+                        "probes as injected timeouts "
+                        "(serve/faultinject.py); replica-side kinds go on "
+                        "the replica's own --fault-plan after --")
     args = parser.parse_args(argv)
 
     if args.replicas <= 0 and not args.adopt:
@@ -130,6 +136,16 @@ def main(argv: list[str] | None = None):
         recorder=recorder,
         log_dir=args.log_dir or None,
     )
+    if args.fault_plan:
+        from distributed_tensorflow_tpu.serve.faultinject import (
+            FaultInjector,
+            FaultPlan,
+        )
+
+        plan = FaultPlan.parse(args.fault_plan)
+        router.fault_injector = FaultInjector(plan, recorder=recorder)
+        logger.info("router fault plan armed: %d scheduled events",
+                    len(plan.events))
     router.start()
     server = build_router_server(router, args.host, args.port)
 

@@ -178,6 +178,11 @@ def build_serving_client(cfg, args):
             # engines; the export/import executables are compiled at
             # startup like the rest of the grid.
             kv_transfer=bool(getattr(args, "disagg_role", "")),
+            # Live stream migration compiles the slot-page export/import
+            # pair so in-flight generations can checkpoint off their
+            # slots and resume on a peer (see DEPLOY.md "Migrating live
+            # streams").
+            stream_migrate=bool(getattr(args, "stream_migrate", False)),
         )
         vocab = pieces["model"].cfg.vocab_size
 
@@ -347,6 +352,26 @@ def main(argv: list[str] | None = None):
                         "transfers on a decode-role server; transfers "
                         "beyond it queue briefly then shed with 429 + "
                         "Retry-After (the sender re-prefills instead)")
+    # Live decode-stream migration (see DEPLOY.md "Migrating live
+    # streams"): compile the slot-page export/import executables, accept
+    # migrated streams on POST /v1/stream_migrate, and export every live
+    # stream to survivors on POST /migratez (the router drives both
+    # during hot_swap deadline expiry and failover).
+    parser.add_argument("--stream-migrate", action="store_true",
+                        help="enable live decode-stream migration: mount "
+                        "POST /v1/stream_migrate + /v1/stream_wait "
+                        "(receive side) and POST /migratez (export side); "
+                        "causal-LM engines only")
+    parser.add_argument("--fault-plan", default="",
+                        help="serving-side fault-injection plan (drills): "
+                        "'seed=..,dispatch_error=N,slow_decode_step=N,"
+                        "wire_corrupt=N,probe_timeout=N,replica_kill=N' or "
+                        "a FaultPlan JSON path; injected into the decode "
+                        "loop and migration wire path "
+                        "(serve/faultinject.py)")
+    parser.add_argument("--fault-steps", type=int, default=1000,
+                        help="decode-step horizon --fault-plan events are "
+                        "placed within when the spec is key=value form")
     parser.add_argument("--flush-admission", action="store_true",
                         help="admit new requests only when the slot table "
                         "is EMPTY (static batching; the A/B baseline for "
@@ -484,9 +509,67 @@ def main(argv: list[str] | None = None):
                 "max_new_tokens at 1 and ship published pages with "
                 "serve.disagg.post_kv_transfer"
             )
+        stream_receiver = migrator = None
+        if args.stream_migrate:
+            if not hasattr(client.engine, "decode"):
+                parser.error("--stream-migrate applies to causal-LM "
+                             "(decode) presets only")
+            from distributed_tensorflow_tpu.serve.disagg import (
+                TransferBudget,
+                make_stream_receiver,
+                migrate_streams,
+            )
+
+            # Inbound stream payloads share the KV-transfer budget when a
+            # disagg decode role already sized one; otherwise size a
+            # dedicated pool from the same flag.
+            if transfer_budget is None:
+                transfer_budget = TransferBudget(
+                    int(args.kv_transfer_budget_mb * 1024 * 1024)
+                )
+            stream_receiver = make_stream_receiver(
+                client.batcher,
+                client.engine,
+                budget=transfer_budget,
+                metrics=client.metrics,
+                recorder=client.recorder,
+            )
+
+            def migrator(targets):
+                return migrate_streams(
+                    client.batcher,
+                    client.engine,
+                    targets,
+                    metrics=client.metrics,
+                    recorder=client.recorder,
+                    fault_injector=client.batcher.fault_injector,
+                )
+
+            logger.info(
+                "live stream migration enabled: POST /v1/stream_migrate "
+                "(budget %.1f MiB in flight), /v1/stream_wait, /migratez",
+                args.kv_transfer_budget_mb,
+            )
+        if args.fault_plan:
+            from distributed_tensorflow_tpu.serve.faultinject import (
+                FaultInjector,
+                FaultPlan,
+            )
+
+            plan = FaultPlan.parse(
+                args.fault_plan, num_steps=args.fault_steps
+            )
+            client.batcher.fault_injector = FaultInjector(
+                plan, recorder=client.recorder
+            )
+            logger.info(
+                "serving fault plan armed: %d scheduled events (seed %s)",
+                len(plan.events), plan.seed,
+            )
         server = build_http_server(
             client, args.host, args.port, trace_dir=args.trace_dir or None,
             kv_receiver=kv_receiver, transfer_budget=transfer_budget,
+            stream_receiver=stream_receiver, migrator=migrator,
         )
         logger.info(
             "ready on http://%s:%d (POST /v1/%s; GET /healthz /sloz "

@@ -236,6 +236,15 @@ def serve_families(
             {(("role", role),): summ for role, summ in kv_secs.items()},
         ))
 
+    # Live stream-migration outcomes (serve/disagg.py): "adopted" and
+    # "rejected" count on the receiving replica, "migrated" and
+    # "readopted" on the exporting one.
+    migrations = Family("serve_stream_migrations_total", "counter",
+                        "live decode-stream migrations by outcome")
+    for outcome, v in m.stream_migrations.snapshot().items():
+        migrations.add(v, {"outcome": outcome})
+    fams.append(migrations)
+
     # Sample-ring quantile gauges (legacy estimator; ms families in the
     # JSON snapshot stay seconds here — exposition is SI).
     fams.append(_summary_quantiles(

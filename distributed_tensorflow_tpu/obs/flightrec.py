@@ -76,6 +76,10 @@ EVENT_KINDS = (
     "kv_transfer_start",   # page-chain transfer admitted (role, bytes)
     "kv_transfer_done",    # chain adopted by the decode role (bytes, s)
     "kv_transfer_reject",  # budget shed / wire refusal (cause)
+    # -- live stream migration (serve/batcher.py, serve/disagg.py) --
+    "stream_export",        # live stream checkpointed off its slot/queue
+    "stream_adopt",         # migrated stream resumed here (pages yes/no)
+    "stream_migrate_reject",  # wire/geometry/state/budget refusal (cause)
     "dump",
 )
 

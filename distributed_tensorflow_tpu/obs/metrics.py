@@ -370,6 +370,10 @@ class ServeMetrics:
         # seconds each transfer took (export + transport + adoption).
         self.kv_transfer_bytes = LabelledCounter()
         self.kv_transfer_seconds = LabelledHistogram()
+        # Live stream migration (serve/disagg.py StreamReceiver +
+        # migrate_streams), keyed by outcome: "adopted"/"rejected" on the
+        # receiving replica, "migrated"/"readopted" on the exporting one.
+        self.stream_migrations = LabelledCounter()
         # ------------------------------------------------ windowed families
         # (obs/timeseries.py) — the SLO/health layer's inputs.  bad_w
         # counts requests that burned availability budget (backpressure +
@@ -473,6 +477,7 @@ class ServeMetrics:
             "spec_rejects": self.spec_rejects.value,
             "kv_transfer_bytes": self.kv_transfer_bytes.snapshot(),
             "kv_transfer_seconds": self.kv_transfer_seconds.snapshot(),
+            "stream_migrations": self.stream_migrations.snapshot(),
             "ttft_ms": {
                 k: (v * 1e3 if k != "count" else v)
                 for k, v in self.ttft.summary().items()

@@ -539,6 +539,7 @@ class _Slot:
         "temperature", "seed", "tokens", "n_dispatched", "t_first",
         "t_last_tok", "prefilling", "chunk_pos", "cached_len", "chain",
         "slot_id", "spec", "prompt_ids", "draft", "verifying",
+        "resume", "full_prompt", "admit_len",
     )
 
     def __init__(self, pending: _Pending, gen: int, payload: dict,
@@ -546,14 +547,25 @@ class _Slot:
         self.pending = pending
         self.gen = gen
         self.prompt_len = len(payload["input_ids"])
-        self.length = self.prompt_len   # cache pages written (advances at
+        # Migration replay (serve/disagg.py stream wire): already-delivered
+        # generated tokens ride as ``resume_tokens`` — the prefill treats
+        # them as prompt suffix (so the next sample lands at the SAME
+        # absolute position the uninterrupted stream would use), while
+        # ``prompt_len`` and the result's token list keep the client's
+        # original view (tokens accumulate across retry hops).
+        self.resume = [int(t) for t in payload.get("resume_tokens", ())]
+        self.full_prompt = (
+            [int(t) for t in payload["input_ids"]] + self.resume
+        )
+        self.admit_len = len(self.full_prompt)
+        self.length = self.admit_len    # cache pages written (advances at
         self.n_dispatched = 0           # DISPATCH, so steps pipeline)
         self.max_new = int(payload.get("max_new_tokens", default_max_new))
         eos = payload.get("eos_id")
         self.eos_id = None if eos is None else int(eos)
         self.temperature = float(payload.get("temperature", 0.0))
         self.seed = int(payload.get("seed", 0))
-        self.tokens: list[int] = []
+        self.tokens: list[int] = list(self.resume)
         self.t_first = 0.0
         self.t_last_tok = 0.0
         # Chunked-prefill bookkeeping (chunked engines only): prompt
@@ -575,6 +587,96 @@ class _Slot:
         self.prompt_ids: list[int] = []
         self.draft: list[int] | None = None
         self.verifying = False
+
+
+@dataclasses.dataclass
+class StreamState:
+    """The host half of a live generation's checkpoint (serve/disagg.py
+    ships it next to the slot's KV pages): everything a peer replica needs
+    to resume the stream bit-identically — prompt, every token generated
+    so far (client-visible, accumulated across hops), the sampling key
+    material, and ``length`` = the cache positions the exported pages
+    cover (``len(input_ids) + len(tokens) - 1``: the newest token's KV is
+    written by the NEXT decode step, exactly as on the source)."""
+
+    request_id: str
+    input_ids: list
+    tokens: list
+    seed: int = 0
+    temperature: float = 0.0
+    eos_id: int | None = None
+    max_new_tokens: int = 32
+    length: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": str(self.request_id),
+            "input_ids": [int(t) for t in self.input_ids],
+            "tokens": [int(t) for t in self.tokens],
+            "seed": int(self.seed),
+            "temperature": float(self.temperature),
+            "eos_id": None if self.eos_id is None else int(self.eos_id),
+            "max_new_tokens": int(self.max_new_tokens),
+            "length": int(self.length),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StreamState":
+        eos = d.get("eos_id")
+        return cls(
+            request_id=str(d["request_id"]),
+            input_ids=[int(t) for t in d["input_ids"]],
+            tokens=[int(t) for t in d["tokens"]],
+            seed=int(d.get("seed", 0)),
+            temperature=float(d.get("temperature", 0.0)),
+            eos_id=None if eos is None else int(eos),
+            max_new_tokens=int(d["max_new_tokens"]),
+            length=int(d.get("length", 0)),
+        )
+
+    def replay_payload(self) -> dict:
+        """The ``/v1/generate`` payload that resumes this stream WITHOUT
+        pages: the generated tokens ride as ``resume_tokens`` and the
+        target re-prefills prompt+prefix at absolute positions — the
+        failover path when the stream's pages died with its replica."""
+        out = {
+            "input_ids": list(self.input_ids),
+            "max_new_tokens": int(self.max_new_tokens),
+            "temperature": float(self.temperature),
+            "seed": int(self.seed),
+        }
+        if self.tokens:
+            out["resume_tokens"] = list(self.tokens)
+        if self.eos_id is not None:
+            out["eos_id"] = int(self.eos_id)
+        return out
+
+
+@dataclasses.dataclass
+class ExportedStream:
+    """One live stream lifted out of a batcher: its :class:`StreamState`,
+    the slot's KV pages when the engine could export them (device arrays
+    ``[nl, cache_len, heads, head_dim]``; ``None`` for queued / still-
+    prefilling streams, which replay page-less), and the victim-held
+    client future the migrator resolves once the stream lands elsewhere
+    (or re-adopts locally on push failure)."""
+
+    state: StreamState
+    pages_k: object | None = None
+    pages_v: object | None = None
+    future: Future | None = None
+
+
+class _ExportRequest:
+    """Cross-thread handshake for ``export_streams``: the HTTP thread
+    parks on ``event`` while the decode-loop thread quiesces in-flight
+    steps, captures every live stream, and posts the results."""
+
+    __slots__ = ("event", "results")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.results: list[ExportedStream] = []
 
 
 class ContinuousBatcher:
@@ -644,7 +746,7 @@ class ContinuousBatcher:
     _RACETRACE_ATTRS = (
         "_queue", "_count", "_closed", "_slots", "_n_active", "_n_inflight",
         "_steps", "_tokens_emitted", "_spec_drafted", "_spec_accepted",
-        "_spec_rejects", "_adoptions",
+        "_spec_rejects", "_adoptions", "_stream_adopts", "_export_req",
     )
 
     def __init__(
@@ -722,6 +824,17 @@ class ContinuousBatcher:
         # swaps the engine's pool refs — same single-dispatcher rule as
         # every other engine touch.
         self._adoptions: deque = deque()
+        # Live-stream migration (serve/disagg.py stream wire): pending
+        # mid-generation adoptions awaiting a free slot, and the at-most-
+        # one outstanding export request the decode loop services once
+        # in-flight steps quiesce. Same single-dispatcher rule: slot
+        # import / export cells only ever dispatch from the loop thread.
+        self._stream_adopts: deque = deque()
+        self._export_req: _ExportRequest | None = None
+        # Serving-side fault injection (serve/faultinject.py): hooks fire
+        # on the decode-step dispatch clock. None = no chaos.
+        self.fault_injector = None
+        self._dispatched_steps = 0
         self._count = 0
         self._served = 0             # lifetime completed requests
         self._closed = False
@@ -814,6 +927,96 @@ class ContinuousBatcher:
             self._cv.notify_all()
         return fut
 
+    def adopt_stream(self, state: StreamState, pages_k=None,
+                     pages_v=None) -> Future:
+        """Resume a migrated live stream here (serve/disagg.py receiver).
+
+        With ``pages_*`` (``[nl, cache_len, heads, head_dim]`` stages —
+        host numpy from the wire, device arrays from a local re-adopt)
+        the stream enters a KV slot MID-GENERATION: the decode loop claims
+        a free slot between steps, scatters the pages via the engine's
+        slot-import cell, and the very next decode step continues the
+        generation — no prefill, no re-computed tokens. Without pages it
+        degrades to a page-less replay: the state's generated prefix
+        re-enqueues as ``resume_tokens`` and the target re-prefills at
+        absolute positions. Both paths are bit-identical to the
+        uninterrupted stream by the (seed, position) sampling contract.
+
+        Returns a Future resolving to the standard generate result with
+        the FULL accumulated token list (resumed + newly generated)."""
+        if pages_k is not None:
+            if not getattr(self._engine, "stream_migrate", False):
+                raise RuntimeError(
+                    "engine built without stream_migrate=True (no "
+                    "slot-import cell); retry page-less"
+                )
+            need = len(state.input_ids) + int(state.max_new_tokens)
+            if need > self._cache_len:
+                raise ValueError(
+                    f"stream of {need} prompt+max_new tokens exceeds the "
+                    f"{self._cache_len}-token cache pages here"
+                )
+            if state.length != len(state.input_ids) + len(state.tokens) - 1:
+                raise ValueError(
+                    f"stream length {state.length} inconsistent with "
+                    f"{len(state.input_ids)} prompt + {len(state.tokens)} "
+                    "generated tokens"
+                )
+            fut: Future = Future()
+            fut.request_id = state.request_id
+            with self._cv:
+                if self._closed:
+                    raise RuntimeError("batcher is closed")
+                self._stream_adopts.append((state, pages_k, pages_v, fut))
+                self._cv.notify_all()
+            self.recorder.record(
+                "stream_adopt", state.request_id,
+                n_tokens=len(state.tokens), pages=True,
+            )
+            return fut
+        fut = self.submit(state.replay_payload(),
+                          request_id=state.request_id)
+        self.recorder.record(
+            "stream_adopt", state.request_id,
+            n_tokens=len(state.tokens), pages=False,
+        )
+        return fut
+
+    def export_streams(self, timeout_s: float = 30.0) -> list[ExportedStream]:
+        """Checkpoint and REMOVE every live stream (occupied slots, queued
+        requests, pending stream adoptions) for migration to a peer
+        replica. Blocks while the decode loop stops dispatching, lets
+        in-flight steps land (so every slot is settled — no donation
+        races, no half-fetched tokens), then gathers each decoding slot's
+        KV lane through the engine's slot-export cell. Streams that have
+        no exportable pages (still prefilling, never admitted, or a
+        pages-less engine) come back as page-less states that replay via
+        ``resume_tokens``. The freed slots re-enter service immediately —
+        callers own pushing the exports somewhere (serve/server.py
+        ``/migratez``) and resolving each stream's victim-held future."""
+        req = _ExportRequest()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._export_req is not None:
+                raise RuntimeError("stream export already in progress")
+            self._export_req = req
+            self._cv.notify_all()
+        if not req.event.wait(timeout_s):
+            with self._cv:
+                if self._export_req is req:
+                    # Never picked up (loop wedged): withdraw the request.
+                    self._export_req = None
+                    raise TimeoutError(
+                        f"stream export not serviced within {timeout_s:.0f}s"
+                    )
+            # Lost the race — the loop is mid-capture; give it a beat.
+            if not req.event.wait(timeout_s):
+                raise TimeoutError(
+                    f"stream export not serviced within {2 * timeout_s:.0f}s"
+                )
+        return req.results
+
     def status(self) -> dict:
         metrics = self.metrics
         with self._cv:
@@ -839,6 +1042,23 @@ class ContinuousBatcher:
                 "tokens_per_step": (
                     self._tokens_emitted / self._steps
                     if self._steps else 0.0
+                ),
+                # Drain-progress estimate (/drainz, /statusz): tokens the
+                # live occupants + queue still owe at worst case (every
+                # stream runs to max_new). Operators and the router read
+                # this to see why a drain is slow — and when to migrate
+                # instead of waiting.
+                "tokens_remaining": sum(
+                    max(0, s.max_new - len(s.tokens))
+                    for s in self._slots if s is not None
+                ) + sum(
+                    max(
+                        1,
+                        int(p.payload.get(
+                            "max_new_tokens", self._default_max_new
+                        )) - len(p.payload.get("resume_tokens", ()) or ()),
+                    )
+                    for p in self._queue
                 ),
             }
             if self._pool is not None:
@@ -907,9 +1127,11 @@ class ContinuousBatcher:
         )
 
     def _take_work(self):
-        """Block until there is something to dispatch; returns
-        ``(admissions, chunk_rows, step, verify)`` — any may be empty/None
-        — or None when closed and fully drained. All bookkeeping (slot
+        """Block until there is something to dispatch; returns ``("work",
+        admissions, chunk_rows, step, verify, adopts, stream_rows)`` — any
+        may be empty/None — or ``("export", ...)`` when a stream export
+        quiesced, or None when closed and fully drained. All bookkeeping
+        (slot
         assignment, trie match, chunk/length advance, draft assembly)
         happens HERE under ``_cv``; the caller just dispatches.
 
@@ -936,6 +1158,7 @@ class ContinuousBatcher:
                 if (
                     self._closed
                     and not self._queue
+                    and not self._stream_adopts
                     and self._n_active == 0
                 ):
                     while self._adoptions:
@@ -944,7 +1167,39 @@ class ContinuousBatcher:
                             fut.set_exception(
                                 RuntimeError("batcher closed")
                             )
+                    if self._export_req is not None:
+                        # Nothing left to export — unblock the waiter.
+                        req = self._export_req
+                        self._export_req = None
+                        req.event.set()
                     return None
+                if self._export_req is not None:
+                    # Stream export pending: stop dispatching and let the
+                    # in-flight steps land, so every slot is SETTLED
+                    # (tokens fetched, lengths final, no donation in
+                    # flight) when the capture runs.
+                    if self._n_inflight:
+                        self._cv.wait()
+                        continue
+                    req = self._export_req
+                    self._export_req = None
+                    exported = []
+                    for i, s in enumerate(self._slots):
+                        if s is None:
+                            continue
+                        self._slots[i] = None
+                        self._n_active -= 1
+                        if self._pool is not None and s.chain is not None:
+                            self._pool.release(s.chain)  # idempotent unpin
+                        exported.append((i, s))
+                    queued = list(self._queue)
+                    self._queue.clear()
+                    adopts_q = list(self._stream_adopts)
+                    self._stream_adopts.clear()
+                    self._count = 0
+                    metrics.queue_depth.set(0)
+                    metrics.slots_active.set(self._n_active)
+                    return ("export", req, exported, queued, adopts_q)
                 # Chain adoptions drain first — a popped adoption's pool
                 # insert + page import runs before the NEXT pass's trie
                 # matches, so admissions planned after this pass can hit
@@ -952,6 +1207,49 @@ class ContinuousBatcher:
                 adopts = []
                 while self._adoptions:
                     adopts.append(self._adoptions.popleft())
+                # Migrated streams claim free slots BEFORE fresh
+                # admissions — they are the oldest work in the house, and
+                # their slot-import dispatch precedes everything else this
+                # pass plans, so the decode step planned below can already
+                # include them.
+                stream_rows = []
+                while self._stream_adopts:
+                    free_ix = next(
+                        (i for i, s in enumerate(self._slots)
+                         if s is None),
+                        None,
+                    )
+                    if free_ix is None:
+                        break
+                    state, pk, pv, fut = self._stream_adopts.popleft()
+                    now = time.monotonic()
+                    pend = _Pending(state.replay_payload(),
+                                    state.request_id)
+                    pend.future = fut
+                    pend.t_taken = now
+                    slot = _Slot(pend, next(self._gens), pend.payload,
+                                 self._default_max_new)
+                    # Mid-generation occupant: its pages land via the
+                    # slot-import cell (no prefill), so the next decode
+                    # step continues at the stream's absolute position.
+                    slot.length = state.length
+                    slot.n_dispatched = len(slot.tokens)
+                    slot.t_first = now
+                    slot.t_last_tok = now
+                    if self._spec_k:
+                        # Fresh SlotSpec: spec state resets cleanly on
+                        # migration (drafting history is rebuilt from
+                        # prompt + accumulated tokens, EMA starts over).
+                        slot.spec = SlotSpec(self._spec_cfg)
+                        slot.prompt_ids = [
+                            int(t) for t in state.input_ids
+                        ]
+                    slot.slot_id = free_ix
+                    self._slots[free_ix] = slot
+                    self._n_active += 1
+                    stream_rows.append((free_ix, slot, pk, pv))
+                if stream_rows:
+                    metrics.slots_active.set(self._n_active)
                 admissions = []
                 free = [
                     i for i, s in enumerate(self._slots) if s is None
@@ -975,10 +1273,10 @@ class ContinuousBatcher:
                             if self._pool is not None:
                                 # Lock order _cv -> pool (never reversed);
                                 # the match pins its chain until the
-                                # gather chunk dispatches.
-                                m = self._pool.match(
-                                    p.payload["input_ids"]
-                                )
+                                # gather chunk dispatches. A resumed
+                                # stream matches on its FULL effective
+                                # prompt (original + resume tokens).
+                                m = self._pool.match(slot.full_prompt)
                                 slot.chain = m
                                 slot.cached_len = m.cached_len
                                 metrics.prefix_lookups.inc()
@@ -989,7 +1287,9 @@ class ContinuousBatcher:
                                     )
                             slot.chunk_pos = slot.cached_len
                         else:
-                            slot.n_dispatched = 1  # prefill's first token
+                            # Prefill's first sampled token (resumed
+                            # tokens are pre-seeded, not dispatched).
+                            slot.n_dispatched = len(slot.tokens) + 1
                         if self._spec_k:
                             slot.spec = SlotSpec(self._spec_cfg)
                             slot.prompt_ids = [
@@ -1010,14 +1310,16 @@ class ContinuousBatcher:
                         if len(planned) >= self._admit_cap:
                             break
                         start = s.chunk_pos
-                        n = min(self._chunk_size, s.prompt_len - start)
+                        n = min(self._chunk_size, s.admit_len - start)
                         s.chunk_pos = start + n
-                        final = s.chunk_pos >= s.prompt_len
+                        final = s.chunk_pos >= s.admit_len
                         first = start == s.cached_len
                         if final:
                             s.prefilling = False
-                            s.n_dispatched = 1  # first token rides the
-                        planned.append(        # final chunk
+                            # First token rides the final chunk (resumed
+                            # tokens are pre-seeded, not dispatched).
+                            s.n_dispatched = len(s.tokens) + 1
+                        planned.append(
                             (i, s, start, n, first, final)
                         )
                     if planned:
@@ -1129,8 +1431,10 @@ class ContinuousBatcher:
                         if s.spec is not None:
                             s.spec.note_plain_step()  # probe clock
                     step = (lengths, active, temps, seeds, tags)
-                if admissions or chunk_rows or step or verify or adopts:
-                    return admissions, chunk_rows, step, verify, adopts
+                if (admissions or chunk_rows or step or verify or adopts
+                        or stream_rows):
+                    return ("work", admissions, chunk_rows, step, verify,
+                            adopts, stream_rows)
                 self._cv.wait()
 
     def _fail_slots(self, tagged: list[tuple[int, int]],
@@ -1183,7 +1487,30 @@ class ContinuousBatcher:
             if work is None:
                 self._completion.put(None)  # unblock the fetch thread
                 return
-            admissions, chunk_rows, step, verify, adopts = work
+            if work[0] == "export":
+                _, req, exported, queued, adopts_q = work
+                self._service_export(req, exported, queued, adopts_q)
+                continue
+            _, admissions, chunk_rows, step, verify, adopts, stream_rows = (
+                work
+            )
+            if stream_rows:
+                # Slot-page import dispatches FIRST: the adopted slots may
+                # already ride this pass's verify/decode step, and stream
+                # order guarantees their lanes hold the migrated KV before
+                # anything reads them.
+                for slot_id, s, pk, pv in stream_rows:
+                    try:
+                        engine.import_slot_pages(
+                            slot_id, pk, pv, int(s.tokens[-1])
+                        )
+                    except Exception as e:  # noqa: BLE001 — fail the stream, not the loop
+                        self._fail_slots([(slot_id, s.gen)], e)
+                        continue
+                    self.recorder.record(
+                        "slot_alloc", s.pending.request_id, slot=slot_id,
+                        prompt_len=s.prompt_len, migrated=True,
+                    )
             if adopts:
                 # Between-steps adoption (serve/disagg.py): index the
                 # chain in the pool, then scatter received pages into the
@@ -1237,7 +1564,7 @@ class ContinuousBatcher:
                     handle = engine.prefill([
                         {
                             "slot": i,
-                            "input_ids": s.pending.payload["input_ids"],
+                            "input_ids": s.full_prompt,
                             "temperature": s.temperature,
                             "seed": s.seed,
                         }
@@ -1263,10 +1590,10 @@ class ContinuousBatcher:
                     handle = engine.prefill_chunks([
                         {
                             "slot": i,
-                            "input_ids": s.pending.payload["input_ids"],
+                            "input_ids": s.full_prompt,
                             "start": start,
                             "n_tokens": n,
-                            "length": s.prompt_len,
+                            "length": s.admit_len,
                             "chain": (
                                 s.chain.blocks
                                 if first and s.chain is not None else ()
@@ -1305,9 +1632,19 @@ class ContinuousBatcher:
                             if first and s.chain is not None:
                                 self._pool.release(s.chain)
                             if final:
-                                new = self._pool.insert(
-                                    s.pending.payload["input_ids"]
-                                )
+                                # A resumed stream's effective prompt
+                                # (prompt + resume_tokens) can run past
+                                # the engine's publishable chain; publish
+                                # the longest prefix the insert cell
+                                # carries rather than raise on the loop
+                                # thread.
+                                key = s.full_prompt
+                                cap = getattr(engine, "_max_chain", None)
+                                if cap is not None:
+                                    key = key[
+                                        : cap * self._pool.block_tokens
+                                    ]
+                                new = self._pool.insert(key)
                                 if new:
                                     engine.insert_prefix(i, new)
                                 touched = True
@@ -1337,6 +1674,19 @@ class ContinuousBatcher:
                     )
             if step:
                 lengths, active, temps, seeds, tags = step
+                inj = self.fault_injector
+                if inj is not None:
+                    # Chaos hooks fire on the decode-step DISPATCH clock
+                    # (serve/faultinject.py): slow_decode_step sleeps
+                    # here, replica_kill dumps + SIGKILLs, dispatch_error
+                    # raises and the step's slots fail like a real engine
+                    # blow-up.
+                    self._dispatched_steps += 1
+                    try:
+                        inj.on_decode_step(self._dispatched_steps)
+                    except Exception as e:  # noqa: BLE001 — injected: fail the step's slots
+                        self._fail_slots(tags, e)
+                        continue
                 self._inflight_sem.acquire()
                 try:
                     handle = engine.decode(lengths, active, temps, seeds)
@@ -1350,6 +1700,75 @@ class ContinuousBatcher:
                 self._completion.put(
                     ("decode", tags, handle, time.monotonic())
                 )
+
+    def _service_export(self, req: _ExportRequest, exported, queued,
+                        adopts_q) -> None:
+        """Decode-loop thread: turn the quiesced occupants into
+        :class:`ExportedStream` records — gathering each settled decoding
+        slot's KV lane through the engine's AOT slot-export cell — then
+        wake the ``export_streams`` caller. Streams without exportable
+        pages (still prefilling, queued, or a migration-less engine)
+        export as page-less states that replay via ``resume_tokens``."""
+        engine = self._engine
+        can_pages = getattr(engine, "stream_migrate", False)
+        out: list[ExportedStream] = []
+        for slot_id, s in exported:
+            p = s.pending
+            state = StreamState(
+                request_id=p.request_id,
+                input_ids=[int(t) for t in p.payload["input_ids"]],
+                tokens=list(s.tokens),
+                seed=s.seed,
+                temperature=s.temperature,
+                eos_id=s.eos_id,
+                max_new_tokens=s.max_new,
+                length=s.length,
+            )
+            pk = pv = None
+            if can_pages and not s.prefilling and s.tokens:
+                try:
+                    pk, pv = engine.export_slot_pages(slot_id)
+                except Exception:  # noqa: BLE001 — degrade to page-less replay
+                    logger.exception(
+                        "slot %d page export failed; stream %s migrates "
+                        "page-less", slot_id, p.request_id,
+                    )
+                    pk = pv = None
+            if pk is None:
+                state.length = 0  # page-less: the replay re-prefills
+            out.append(ExportedStream(state, pk, pv, p.future))
+            self.recorder.record(
+                "stream_export", p.request_id, slot=slot_id,
+                n_tokens=len(s.tokens), pages=pk is not None,
+            )
+        for p in queued:
+            pl = p.payload
+            eos = pl.get("eos_id")
+            state = StreamState(
+                request_id=p.request_id,
+                input_ids=[int(t) for t in pl["input_ids"]],
+                tokens=[int(t) for t in pl.get("resume_tokens", ())],
+                seed=int(pl.get("seed", 0)),
+                temperature=float(pl.get("temperature", 0.0)),
+                eos_id=None if eos is None else int(eos),
+                max_new_tokens=int(
+                    pl.get("max_new_tokens", self._default_max_new)
+                ),
+            )
+            out.append(ExportedStream(state, None, None, p.future))
+            self.recorder.record(
+                "stream_export", p.request_id, queued=True, pages=False,
+            )
+        for state, pk, pv, fut in adopts_q:
+            # A migrated-in stream caught mid-handoff migrates onward
+            # with the pages it arrived with.
+            out.append(ExportedStream(state, pk, pv, fut))
+            self.recorder.record(
+                "stream_export", state.request_id, queued=True,
+                pages=pk is not None,
+            )
+        req.results = out
+        req.event.set()
 
     # ---------------------------------------------------------- completion
 
@@ -1641,6 +2060,10 @@ class ContinuousBatcher:
                 while self._queue:
                     p = self._queue.popleft()
                     p.future.set_exception(RuntimeError("batcher closed"))
+                while self._stream_adopts:
+                    *_, fut = self._stream_adopts.popleft()
+                    if not fut.cancelled():
+                        fut.set_exception(RuntimeError("batcher closed"))
                 self._count = 0
                 self.metrics.queue_depth.set(0)
             self._cv.notify_all()

@@ -54,6 +54,33 @@ Truncation, a bad magic, a version from the future, a geometry mismatch,
 or a payload CRC mismatch all raise :class:`WireError` — the receiver
 refuses rather than adopting garbage KV (tests/test_disagg.py pins each
 refusal).
+
+Wire format (version 2, live stream migration)::
+
+    magic  b"KVPG"                      4 bytes
+    version = 2                         u16 big-endian
+    header_len                          u32 big-endian
+    header JSON (utf-8), keys:
+        stream      the StreamState dict (request_id, input_ids, tokens,
+                    seed, temperature, eos_id, max_new_tokens, length)
+        page_meta   {num_layers, cache_len, heads, head_dim, dtype} of
+                    the SOURCE slot cache ({} when page-less)
+        n_tokens    KV positions carried (== stream.length; 0 = page-less
+                    replay — the receiver re-prefills from the tokens)
+        layout      axis-order tag ("lthd" = layer,token,head,dim)
+        crc32       zlib.crc32 of canonical-stream-JSON + k+v payload —
+                    the CRC covers state AND pages, so a tampered token
+                    list refuses exactly like a corrupt page byte
+    k positions                         n_tokens contiguous C-order rows
+    v positions                         same shape, immediately after
+
+Version 1 buffers fed to :func:`deserialize_stream` (and v2 buffers fed
+to :func:`deserialize_chain`) refuse on the version field — the two
+formats share a magic but never a parser. The receiving side re-pads the
+carried positions to its own ``cache_len`` (refusing streams longer than
+its cache) and resumes decoding mid-generation via
+``ContinuousBatcher.adopt_stream`` (tests/test_migrate.py pins each
+refusal and the bit-parity contract).
 """
 
 from __future__ import annotations
@@ -73,20 +100,29 @@ from distributed_tensorflow_tpu.serve.batcher import Backpressure
 __all__ = [
     "WireError",
     "WIRE_VERSION",
+    "WIRE_VERSION_STREAM",
     "serialize_chain",
     "deserialize_chain",
+    "serialize_stream",
+    "deserialize_stream",
     "TransferBudget",
     "DisaggServingPair",
     "make_kv_receiver",
     "post_kv_transfer",
+    "StreamReceiver",
+    "make_stream_receiver",
+    "migrate_streams",
+    "post_stream_migrate",
 ]
 
 logger = logging.getLogger(__name__)
 
 WIRE_MAGIC = b"KVPG"
 WIRE_VERSION = 1
+WIRE_VERSION_STREAM = 2
 _PREFIX = struct.Struct(">4sHI")  # magic, version, header_len
 _LAYOUT = "lbthd"
+_STREAM_LAYOUT = "lthd"
 
 
 class WireError(ValueError):
@@ -204,6 +240,166 @@ def deserialize_chain(buf: bytes):
     pages_k = np.frombuffer(payload[:nbytes], dtype).reshape(shape)
     pages_v = np.frombuffer(payload[nbytes:], dtype).reshape(shape)
     return token_ids, pages_k, pages_v, header
+
+
+# ------------------------------------------------- wire format v2 (streams)
+
+
+def _canonical_state(state: dict) -> bytes:
+    """The CRC-covered byte form of a stream-state dict: minimal JSON
+    with sorted keys, so serializer and receiver derive identical bytes
+    from identical state regardless of dict insertion order."""
+    return json.dumps(state, separators=(",", ":"), sort_keys=True).encode(
+        "utf-8"
+    )
+
+
+def serialize_stream(state, pages_k=None, pages_v=None,
+                     page_meta: dict | None = None) -> bytes:
+    """Serialize a live decode stream for the cross-process transport.
+
+    ``state`` is a :class:`~.batcher.StreamState` (or its dict form);
+    ``pages_*`` are the slot-export stages ``[num_layers, T, heads,
+    head_dim]`` — sliced here to the state's ``length`` positions (the
+    only ones a resumed slot will ever attend over) — and ``page_meta``
+    is the source engine's :meth:`stream_page_meta` digest. Both pages
+    ``None`` ships a page-less stream (``n_tokens=0``): the receiver
+    re-prefills from the state's tokens, which is bit-identical by the
+    (seed, absolute position) sampling contract, just slower.
+    """
+    sd = state.to_dict() if hasattr(state, "to_dict") else dict(state)
+    sbytes = _canonical_state(sd)
+    if (pages_k is None) != (pages_v is None):
+        raise ValueError("pages_k and pages_v must both be given or both None")
+    if pages_k is None:
+        n, meta, payload = 0, {}, b""
+    else:
+        if page_meta is None:
+            raise ValueError(
+                "a page-carrying stream needs the source engine's "
+                "stream_page_meta"
+            )
+        n = int(sd.get("length", 0))
+        if n <= 0:
+            raise ValueError(
+                f"a page-carrying stream needs state length >= 1, got {n}"
+            )
+        # device_get is fine here: stream serialization runs off the
+        # decode loop (export already copied the slot out of the cache).
+        pk = np.ascontiguousarray(np.asarray(pages_k)[:, :n])
+        pv = np.ascontiguousarray(np.asarray(pages_v)[:, :n])
+        if pk.shape != pv.shape:
+            raise ValueError(f"k/v stage shapes differ: {pk.shape} vs {pv.shape}")
+        if pk.ndim != 4:
+            raise ValueError(f"stream pages must be 4-D [l,t,h,d], got {pk.shape}")
+        meta = {
+            "num_layers": int(pk.shape[0]),
+            "cache_len": int(page_meta["cache_len"]),
+            "heads": int(pk.shape[2]),
+            "head_dim": int(pk.shape[3]),
+            "dtype": str(pk.dtype.name),
+        }
+        if meta != dict(page_meta):
+            raise ValueError(
+                f"pages {meta} disagree with the engine's "
+                f"stream_page_meta {dict(page_meta)}"
+            )
+        payload = pk.tobytes() + pv.tobytes()
+    header = {
+        "stream": sd,
+        "page_meta": meta,
+        "n_tokens": n,
+        "layout": _STREAM_LAYOUT,
+        "crc32": zlib.crc32(sbytes + payload) & 0xFFFFFFFF,
+    }
+    hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return (
+        _PREFIX.pack(WIRE_MAGIC, WIRE_VERSION_STREAM, len(hbytes))
+        + hbytes + payload
+    )
+
+
+def deserialize_stream(buf: bytes):
+    """Parse + verify a stream wire buffer: returns ``(state_dict,
+    pages_k, pages_v, header)`` — pages ``None`` for a page-less stream.
+    Every malformation raises :class:`WireError` BEFORE any byte of
+    state or pages is trusted (fail-closed: refuse, never guess)."""
+    if len(buf) < _PREFIX.size:
+        raise WireError(
+            f"buffer of {len(buf)} bytes is shorter than the "
+            f"{_PREFIX.size}-byte wire prefix"
+        )
+    magic, version, hlen = _PREFIX.unpack_from(buf)
+    if magic != WIRE_MAGIC:
+        raise WireError(f"bad magic {magic!r} (expected {WIRE_MAGIC!r})")
+    if version != WIRE_VERSION_STREAM:
+        raise WireError(
+            f"stream wire version {version} unsupported (speaker of "
+            f"version {WIRE_VERSION_STREAM}); refusing rather than "
+            "guessing the layout"
+        )
+    if len(buf) < _PREFIX.size + hlen:
+        raise WireError(
+            f"truncated header: need {hlen} bytes, have "
+            f"{len(buf) - _PREFIX.size}"
+        )
+    try:
+        header = json.loads(buf[_PREFIX.size:_PREFIX.size + hlen])
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise WireError(f"corrupt header JSON: {e}") from e
+    try:
+        sd = dict(header["stream"])
+        n = int(header["n_tokens"])
+        layout = header["layout"]
+        crc = int(header["crc32"])
+        length = int(sd["length"])
+        [int(t) for t in sd["input_ids"]]
+        [int(t) for t in sd["tokens"]]
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"header missing/invalid field: {e}") from e
+    if layout != _STREAM_LAYOUT:
+        raise WireError(
+            f"stream page layout {layout!r} unsupported "
+            f"(expected {_STREAM_LAYOUT!r})"
+        )
+    payload = buf[_PREFIX.size + hlen:]
+    if n == 0:
+        if payload:
+            raise WireError(
+                f"page-less stream carries {len(payload)} stray payload bytes"
+            )
+        pk = pv = None
+        shape = dtype = nbytes = None
+    else:
+        if n != length:
+            raise WireError(
+                f"header carries {n} KV positions but the stream state's "
+                f"length is {length} — a resumed slot would attend over "
+                "positions that never arrived"
+            )
+        try:
+            meta = header["page_meta"]
+            shape = (
+                int(meta["num_layers"]), n,
+                int(meta["heads"]), int(meta["head_dim"]),
+            )
+            dtype = np.dtype(meta["dtype"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise WireError(f"header missing/invalid field: {e}") from e
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if len(payload) != 2 * nbytes:
+            raise WireError(
+                f"payload of {len(payload)} bytes != 2 x {nbytes} "
+                f"for {shape} {dtype.name} stream pages"
+            )
+    if zlib.crc32(_canonical_state(sd) + payload) & 0xFFFFFFFF != crc:
+        raise WireError(
+            "stream CRC mismatch: state or pages corrupted in flight"
+        )
+    if n:
+        pk = np.frombuffer(payload[:nbytes], dtype).reshape(shape)
+        pv = np.frombuffer(payload[nbytes:], dtype).reshape(shape)
+    return sd, pk, pv, header
 
 
 # --------------------------------------------------------- transfer budget
@@ -579,6 +775,336 @@ def post_kv_transfer(host: str, port: int, buf: bytes, *,
         if resp.status != 200:
             raise RuntimeError(
                 f"kv transfer failed: HTTP {resp.status} {out}"
+            )
+        return out
+    finally:
+        conn.close()
+
+
+# ------------------------------------------------- cross-process migration
+
+
+def _pad_stream_stage(stage: np.ndarray, cache_len: int) -> np.ndarray:
+    """Pad a ``[l, n, h, d]`` stream stage to the receiver's full
+    ``cache_len`` positions (the slot-import cell scatters whole slots;
+    pad positions sit beyond ``length`` and are never attended)."""
+    n = stage.shape[1]
+    if n > cache_len:
+        raise WireError(
+            f"stream carries {n} KV positions but this engine's cache "
+            f"holds {cache_len}"
+        )
+    if n == cache_len:
+        return stage
+    pad = np.zeros(
+        (stage.shape[0], cache_len - n, *stage.shape[2:]), stage.dtype
+    )
+    return np.concatenate([stage, pad], axis=1)
+
+
+class StreamReceiver:
+    """The survivor half of live stream migration: a ``bytes -> dict``
+    callable the HTTP server mounts at ``POST /v1/stream_migrate``, plus
+    the pending registry ``POST /v1/stream_wait`` blocks on.
+
+    Verifies the v2 wire buffer, checks slot geometry against the local
+    engine, budget-gates the bytes (same :class:`TransferBudget` as KV
+    chains — stream payloads and chain payloads share one interconnect),
+    and resumes via ``batcher.adopt_stream``. The adoption future —
+    which resolves with the COMPLETED generation — is registered under
+    the stream's original request id so the migration orchestrator can
+    collect the finished result from this replica with
+    ``POST /v1/stream_wait`` instead of replaying from scratch. Raises
+    ``WireError`` (400) on refusal, ``Backpressure`` (429) on shed.
+    """
+
+    _RACETRACE_ATTRS = ("_pending",)
+
+    def __init__(self, batcher, engine=None, *,
+                 budget: TransferBudget | None = None,
+                 metrics=None, recorder=None):
+        self.batcher = batcher
+        self.engine = engine
+        self.budget = budget
+        self.metrics = metrics
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        self._lock = threading.Lock()
+        self._pending: dict[str, object] = {}  # request_id -> Future
+
+    def _reject(self, cause: str, err: Exception) -> None:
+        self.recorder.record(
+            "stream_migrate_reject", "", cause=cause, error=str(err)
+        )
+        if self.metrics is not None:
+            self.metrics.stream_migrations.inc("rejected")
+
+    def __call__(self, body: bytes) -> dict:
+        from distributed_tensorflow_tpu.serve.batcher import StreamState
+
+        try:
+            sd, pk, pv, header = deserialize_stream(body)
+        except WireError as e:
+            self._reject("wire", e)
+            raise
+        try:
+            state = StreamState.from_dict(sd)
+        except (KeyError, TypeError, ValueError) as e:
+            self._reject("state", e)
+            raise WireError(f"stream state invalid: {e}") from e
+        if pk is not None:
+            engine = self.engine
+            if engine is None or not getattr(engine, "stream_migrate", False):
+                e = WireError(
+                    "this engine cannot import stream pages (built without "
+                    "stream_migrate); retry page-less"
+                )
+                self._reject("no_import", e)
+                raise e
+            meta = engine.stream_page_meta()
+            got = {
+                k: v for k, v in dict(header["page_meta"]).items()
+                if k != "cache_len"
+            }
+            expect = {k: v for k, v in meta.items() if k != "cache_len"}
+            if got != expect:
+                e = WireError(
+                    f"stream page geometry {got} does not match this "
+                    f"engine's {expect}"
+                )
+                self._reject("geometry", e)
+                raise e
+            try:
+                pk = _pad_stream_stage(pk, int(meta["cache_len"]))
+                pv = _pad_stream_stage(pv, int(meta["cache_len"]))
+            except WireError as e:
+                self._reject("geometry", e)
+                raise
+        nbytes = len(body)
+        if self.budget is not None:
+            try:
+                self.budget.acquire(nbytes)
+            except Backpressure as e:
+                self._reject("budget", e)
+                raise
+        # Release as soon as the adoption is enqueued: the wire bytes are
+        # landed host-side by then, and holding the budget across a whole
+        # resumed generation would starve every later migration.
+        try:
+            try:
+                fut = self.batcher.adopt_stream(state, pk, pv)
+            except Backpressure as e:
+                self._reject("budget", e)
+                raise
+            except (ValueError, RuntimeError) as e:
+                self._reject("state", e)
+                raise WireError(f"stream refused: {e}") from e
+        finally:
+            if self.budget is not None:
+                self.budget.release(nbytes)
+        with self._lock:
+            self._pending[state.request_id] = fut
+        if self.metrics is not None:
+            self.metrics.stream_migrations.inc("adopted")
+        return {
+            "adopted": True,
+            "request_id": state.request_id,
+            "pages": pk is not None,
+            "bytes": nbytes,
+            "resume_at": len(state.tokens),
+        }
+
+    def wait(self, request_id: str, timeout_s: float | None = None) -> dict:
+        """Block for an adopted stream's finished generation (the
+        ``/v1/stream_wait`` body). Raises :class:`KeyError` for an id
+        this replica never adopted (server maps it to 404 — the caller
+        falls back to a resume_tokens replay)."""
+        import concurrent.futures
+
+        with self._lock:
+            fut = self._pending.get(request_id)
+        if fut is None:
+            raise KeyError(request_id)
+        try:
+            out = fut.result(timeout_s)
+        except (concurrent.futures.TimeoutError, TimeoutError):
+            # Still generating: keep the registration so a later wait
+            # (or a retry after the orchestrator's own timeout) can
+            # still collect the stream instead of replaying it.
+            raise
+        except Exception:
+            with self._lock:
+                self._pending.pop(request_id, None)
+            raise
+        with self._lock:
+            self._pending.pop(request_id, None)
+        return out
+
+    def digest(self) -> dict:
+        """The ``/statusz`` ``stream_migrate`` section."""
+        with self._lock:
+            return {"pending_streams": len(self._pending)}
+
+
+def make_stream_receiver(batcher, engine=None, *,
+                         budget: TransferBudget | None = None,
+                         metrics=None, recorder=None) -> StreamReceiver:
+    """Factory mirroring :func:`make_kv_receiver` for the stream path."""
+    return StreamReceiver(
+        batcher, engine, budget=budget, metrics=metrics, recorder=recorder
+    )
+
+
+def migrate_streams(batcher, engine, targets, *, metrics=None,
+                    recorder=None, fault_injector=None,
+                    timeout_s: float = 30.0) -> dict:
+    """Victim-side migration orchestration (``POST /migratez``): export
+    every live stream, push each to a survivor, and resolve the
+    victim-held client futures with a ``status: "migrated"`` digest the
+    router follows up on (``POST /v1/stream_wait`` against the target,
+    or a ``resume_tokens`` replay when the target dies too).
+
+    ``targets`` is a list of ``(host, port)`` pairs (the router's pick);
+    streams round-robin across them. A push that refuses pages
+    (``WireError`` — e.g. a geometry-mismatched survivor) retries
+    page-less to the same target before moving on; a stream no target
+    accepts re-adopts LOCALLY so it finishes here rather than dying —
+    migration degrades, it never loses a stream. ``fault_injector`` is
+    the serving :class:`~distributed_tensorflow_tpu.serve.faultinject.FaultInjector`
+    (``wire_corrupt`` flips a byte of the nth outbound buffer — the
+    receiver's CRC refusal is the thing under drill).
+    """
+    recorder = recorder if recorder is not None else NULL_RECORDER
+    targets = [(str(h), int(p)) for h, p in targets]
+    if not targets:
+        raise ValueError("migrate_streams needs at least one target")
+    exported = batcher.export_streams(timeout_s)
+    meta = (
+        engine.stream_page_meta()
+        if getattr(engine, "stream_migrate", False) else None
+    )
+    migrated, readopted = 0, 0
+    n_sent = 0
+    outcomes = []
+    for i, exp in enumerate(exported):
+        state = exp.state
+        bufs = []
+        if exp.pages_k is not None and meta is not None:
+            bufs.append(serialize_stream(
+                state, exp.pages_k, exp.pages_v, meta
+            ))
+        bufs.append(serialize_stream(state))  # page-less fallback
+        landed = None
+        for attempt in range(len(targets)):
+            host, port = targets[(i + attempt) % len(targets)]
+            for buf in bufs:
+                n_sent += 1
+                if fault_injector is not None and fault_injector.check_wire(
+                    n_sent
+                ):
+                    # Corrupt the last payload byte (or the header when
+                    # page-less): the receiver must refuse on CRC.
+                    buf = buf[:-1] + bytes([buf[-1] ^ 0xFF])
+                try:
+                    out = post_stream_migrate(
+                        host, port, buf, timeout_s=timeout_s
+                    )
+                except WireError:
+                    continue  # refused (pages or corruption): next form
+                except Exception:  # noqa: BLE001 — shed, dead target, ...
+                    break  # this target is out; try the next one
+                landed = (host, port, out)
+                break
+            if landed is not None:
+                break
+        if landed is not None:
+            host, port, out = landed
+            migrated += 1
+            if metrics is not None:
+                metrics.stream_migrations.inc("migrated")
+            outcomes.append({
+                "request_id": state.request_id,
+                "outcome": "migrated",
+                "target": f"{host}:{port}",
+                "pages": bool(out.get("pages")),
+            })
+            if exp.future is not None:
+                exp.future.set_result({
+                    "status": "migrated",
+                    "target": f"{host}:{port}",
+                    "request_id": state.request_id,
+                    "tokens": list(state.tokens),
+                    "n_tokens": len(state.tokens),
+                    "prompt_len": len(state.input_ids),
+                })
+        else:
+            # No survivor took it: keep the stream alive HERE (the drain
+            # waits a little longer for it, but nothing is lost) and let
+            # the original future resolve from the re-adopted run.
+            readopted += 1
+            if metrics is not None:
+                metrics.stream_migrations.inc("readopted")
+            outcomes.append({
+                "request_id": state.request_id,
+                "outcome": "readopted",
+            })
+            fut = batcher.adopt_stream(state, exp.pages_k, exp.pages_v)
+            if exp.future is not None:
+                _chain_future(fut, exp.future)
+    digest = {
+        "exported": len(exported),
+        "migrated": migrated,
+        "readopted": readopted,
+        "streams": outcomes,
+    }
+    recorder.record(
+        "stream_export", "", exported=len(exported), migrated=migrated,
+        readopted=readopted,
+    )
+    return digest
+
+
+def _chain_future(src, dst) -> None:
+    """Mirror ``src``'s eventual result/exception onto ``dst``."""
+
+    def _copy(f):
+        err = f.exception()
+        if err is not None:
+            dst.set_exception(err)
+        else:
+            dst.set_result(f.result())
+
+    src.add_done_callback(_copy)
+
+
+def post_stream_migrate(host: str, port: int, buf: bytes, *,
+                        timeout_s: float = 10.0) -> dict:
+    """Victim-process half of live migration: POST a serialized stream
+    to a survivor's ``/v1/stream_migrate``. Returns the adoption digest;
+    raises ``Backpressure`` on a 429 shed and ``WireError`` on a 400
+    refusal (mirroring the in-process paths)."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request(
+            "POST", "/v1/stream_migrate", body=buf,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        resp = conn.getresponse()
+        body = resp.read()
+        try:
+            out = json.loads(body)
+        except json.JSONDecodeError:
+            out = {"error": body[:200].decode("utf-8", "replace")}
+        if resp.status == 429:
+            raise Backpressure(
+                float(resp.headers.get("Retry-After", 1.0))
+            )
+        if resp.status == 400:
+            raise WireError(out.get("error", "stream migrate refused"))
+        if resp.status != 200:
+            raise RuntimeError(
+                f"stream migrate failed: HTTP {resp.status} {out}"
             )
         return out
     finally:

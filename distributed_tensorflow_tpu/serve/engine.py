@@ -901,6 +901,37 @@ def _make_pool_import():
     return import_fn
 
 
+def _make_slot_export():
+    """Live-stream checkpoint executable body (serve/disagg.py stream
+    migration): gather ONE slot's lane out of the slot-table KV cache
+    into a ``[nl, cache_len, heads, head_dim]`` stage. The cache operands
+    are NOT donated — export copies between decode steps and the cache
+    stays live (sibling of :func:`_make_pool_export`, at slot instead of
+    pool-block granularity)."""
+
+    def export_fn(ck, cv, slot):
+        return (jnp.take(ck, slot, axis=1), jnp.take(cv, slot, axis=1))
+
+    return export_fn
+
+
+def _make_slot_import():
+    """Resume-a-migrated-stream executable body: scatter a received
+    ``[nl, cache_len, heads, head_dim]`` stage into ONE slot's cache lane
+    and seed ``last_token[slot]`` with the stream's newest token, so the
+    very next decode step continues the generation mid-flight. Cache /
+    last_token operands are DONATED like every executable in the decode
+    chain; dispatches between decode steps on the loop thread."""
+
+    def import_fn(ck, cv, last, stage_k, stage_v, slot, tok):
+        ck = ck.at[:, slot].set(stage_k)
+        cv = cv.at[:, slot].set(stage_v)
+        last = last.at[slot].set(tok)
+        return ck, cv, last
+
+    return import_fn
+
+
 class CausalLMEngine(_AotEngine):
     """Autoregressive generation over a trained :class:`CausalLM` checkpoint
     with a paged, slot-addressed KV cache.
@@ -979,6 +1010,7 @@ class CausalLMEngine(_AotEngine):
         spec_min_match: int = 2,
         spec_backoff: float = 0.25,
         kv_transfer: bool = False,
+        stream_migrate: bool = False,
         memory=None,
     ):
         if slots < 1:
@@ -1117,10 +1149,18 @@ class CausalLMEngine(_AotEngine):
         self._export_compiled = None
         self._import_compiled = None
         self._kv_transfer = False
+        # Live-stream migration (serve/disagg.py): two extra AOT cells —
+        # slot export (checkpoint a live generation's KV lane) and slot
+        # import (resume it here) — valid in BOTH prefill modes.
+        self.stream_migrate = bool(stream_migrate)
+        self._slot_export_compiled = None
+        self._slot_import_compiled = None
         n_spec_cells = 1 if self.spec_tokens else 0
+        n_mig_cells = 2 if self.stream_migrate else 0
         if not self._chunked_mode:
             self._plan_cells(
                 len(self.batch_tiers) * len(self.buckets) + 1 + n_spec_cells
+                + n_mig_cells
             )
             for T in self.batch_tiers:
                 fn = self._wrap(_make_causal_prefill(self.model), n_batch=6)
@@ -1152,6 +1192,7 @@ class CausalLMEngine(_AotEngine):
                 len(self.batch_tiers) * len(self._chunk_buckets) + 1
                 + (1 if self.prefix_cache is not None else 0)
                 + (2 if self._kv_transfer else 0) + n_spec_cells
+                + n_mig_cells
             )
             chunk_fn = self._wrap_chunk(
                 _make_causal_chunk_prefill(self.model, self.cache_len)
@@ -1289,6 +1330,49 @@ class CausalLMEngine(_AotEngine):
                     .compile()
                 ),
             )
+        if self.stream_migrate:
+            stage_spec = (
+                P(None, None, "model", None) if self._model_sharded else P()
+            )
+            self._slot_stage_sharding = NamedSharding(self.mesh, stage_spec)
+            slot_stage_struct = jax.ShapeDtypeStruct(
+                (cfg.num_layers, self.cache_len, cfg.num_heads,
+                 cfg.hidden_size // cfg.num_heads),
+                cfg.dtype, sharding=self._slot_stage_sharding,
+            )
+            # Slot export reads the live cache between decode steps — the
+            # cache operands are NOT donated (the stream may stay resident
+            # if the push fails and the batcher re-adopts it locally).
+            sexp_fn = self._wrap_slot_export(_make_slot_export())
+            self._slot_export_compiled = self._compile_cell(
+                f"lm/{self.layout}/slot_export",
+                lambda: (
+                    jax.jit(sexp_fn)
+                    .lower(
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._rep_struct((), jnp.int32),
+                    )
+                    .compile()
+                ),
+            )
+            simp_fn = self._wrap_slot_import(_make_slot_import())
+            self._slot_import_compiled = self._compile_cell(
+                f"lm/{self.layout}/slot_import",
+                lambda: (
+                    jax.jit(simp_fn, donate_argnums=(0, 1, 2))
+                    .lower(
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._rep_struct((slots,), jnp.int32),
+                        slot_stage_struct,
+                        slot_stage_struct,
+                        self._rep_struct((), jnp.int32),
+                        self._rep_struct((), jnp.int32),
+                    )
+                    .compile()
+                ),
+            )
         logger.info(
             "causal-LM engine ready: layout=%s slots=%d cache_len=%d "
             "buckets=%s tiers=%s chunk=%s pool_blocks=%s spec_k=%s "
@@ -1299,7 +1383,7 @@ class CausalLMEngine(_AotEngine):
             self.spec_tokens or None,
             len(self._prefill_compiled) + len(self._chunk_compiled) + 1
             + (1 if self.prefix_cache is not None else 0)
-            + (2 if self._kv_transfer else 0) + n_spec_cells,
+            + (2 if self._kv_transfer else 0) + n_spec_cells + n_mig_cells,
         )
 
     @staticmethod
@@ -1481,6 +1565,37 @@ class CausalLMEngine(_AotEngine):
             check_vma=False,
         )
 
+    def _wrap_slot_export(self, fn):
+        """Slot-lane export for stream migration: the gathered stage drops
+        the slot dim, so its head axis sits one position earlier than the
+        cache spec's — per-shard gathers stay local either way."""
+        if not self._model_sharded:
+            return fn
+        cache, rep = self._cache_spec, P()
+        stage = P(None, None, "model", None)
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(cache, cache, rep),
+            out_specs=(stage, stage),
+            check_vma=False,
+        )
+
+    def _wrap_slot_import(self, fn):
+        """Slot-lane import (resume a migrated stream): the received stage
+        shards its head axis like the cache it scatters into."""
+        if not self._model_sharded:
+            return fn
+        cache, rep = self._cache_spec, P()
+        stage = P(None, None, "model", None)
+        return jax.shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=(cache, cache, rep, stage, stage, rep, rep),
+            out_specs=(cache, cache, rep),
+            check_vma=False,
+        )
+
     # -- request surface ------------------------------------------------
 
     def bucket_for(self, length: int) -> int:
@@ -1505,10 +1620,27 @@ class CausalLMEngine(_AotEngine):
         ids = np.asarray(payload.get("input_ids", ()))
         if ids.ndim != 1 or ids.size == 0:
             raise RequestError("input_ids must be a non-empty 1-D id list")
-        self.bucket_for(ids.shape[0])
         max_new = int(payload.get("max_new_tokens", self.max_new_tokens))
         if max_new < 1:
             raise RequestError("max_new_tokens must be >= 1")
+        # Migration replay: ``resume_tokens`` are already-delivered
+        # generated tokens the re-prefill treats as prompt suffix — the
+        # effective prompt must bucket, and the stream must still owe
+        # tokens (a fully-satisfied stream has nothing to resume).
+        res = np.asarray(payload.get("resume_tokens", ()))
+        if res.size and res.ndim != 1:
+            raise RequestError("resume_tokens must be a 1-D id list")
+        if res.size >= max_new:
+            raise RequestError(
+                f"resume_tokens of {res.size} already satisfy "
+                f"max_new_tokens {max_new}: nothing left to generate"
+            )
+        if not self._chunked_mode:
+            # Monolithic prefill pads the whole effective prompt into one
+            # bucket executable; chunked mode splits it, so there the only
+            # real bound is the cache-page check below (a migrated stream's
+            # prompt + resumed prefix routinely exceeds the largest bucket).
+            self.bucket_for(ids.shape[0] + res.size)
         if ids.shape[0] + max_new > self.cache_len:
             raise RequestError(
                 f"prompt of {ids.shape[0]} + max_new_tokens {max_new} "
@@ -1518,7 +1650,11 @@ class CausalLMEngine(_AotEngine):
             raise RequestError("temperature must be >= 0")
 
     def request_bucket(self, payload: dict) -> int:
-        return self.bucket_for(np.asarray(payload["input_ids"]).shape[0])
+        n = np.asarray(payload["input_ids"]).shape[0]
+        n += np.asarray(payload.get("resume_tokens", ())).size
+        if self._chunked_mode and n > self.buckets[-1]:
+            return self.buckets[-1]  # queue key only: chunks split the rest
+        return self.bucket_for(n)
 
     # -- the two dispatch points (decode-loop thread only: both swap the
     # -- engine's device-state refs, which is single-writer by contract) --
@@ -1802,6 +1938,62 @@ class CausalLMEngine(_AotEngine):
             "head_dim": int(hd),
             "dtype": str(np.dtype(self._pool_k.dtype).name),
             "max_chain": int(self._max_chain),
+        }
+
+    # -- live-stream migration (serve/disagg.py stream wire) ------------
+
+    def export_slot_pages(self, slot: int):
+        """Checkpoint ONE live slot's KV lane: returns device arrays
+        ``[nl, cache_len, heads, head_dim]`` (k, v). Decode-loop thread
+        only, between dispatches with nothing in flight — the batcher's
+        ``export_streams`` guarantees the lane is settled, so unlike
+        ``export_prefix_pages`` there is no donation race to retry.
+        Requires ``stream_migrate=True`` at construction."""
+        if self._slot_export_compiled is None:
+            raise RuntimeError(
+                "engine built without stream_migrate=True (no slot-export "
+                "cell)"
+            )
+        return self._slot_export_compiled(
+            self._cache_k, self._cache_v,
+            jax.device_put(np.int32(slot), self._rep),
+        )
+
+    def import_slot_pages(self, slot: int, pages_k, pages_v,
+                          last_token: int) -> None:
+        """Adopt a migrated stream's KV lane into ``slot`` and seed
+        ``last_token[slot]`` so the next decode step continues the
+        generation. ``pages_*`` are full ``[nl, cache_len, heads,
+        head_dim]`` stages (the wire path pads short payloads back up —
+        trailing positions are dead weight the causal mask never reads).
+        Decode-loop thread only: swaps the cache refs like every
+        dispatch. Requires ``stream_migrate=True`` at construction."""
+        if self._slot_import_compiled is None:
+            raise RuntimeError(
+                "engine built without stream_migrate=True (no slot-import "
+                "cell)"
+            )
+        ck, cv, last = self._slot_import_compiled(
+            self._cache_k, self._cache_v, self._last_token,
+            jax.device_put(pages_k, self._slot_stage_sharding),
+            jax.device_put(pages_v, self._slot_stage_sharding),
+            jax.device_put(np.int32(slot), self._rep),
+            jax.device_put(np.int32(last_token), self._rep),
+        )
+        self._cache_k, self._cache_v, self._last_token = ck, cv, last
+
+    def stream_page_meta(self) -> dict:
+        """Slot-lane geometry digest the stream wire format stamps into
+        its header — two engines can ship live streams between each other
+        iff these match (``cache_len`` may differ: the receiver re-pads,
+        refusing only streams longer than its own lanes)."""
+        nl, _, cache_len, heads, hd = self._cache_k.shape
+        return {
+            "num_layers": int(nl),
+            "cache_len": int(cache_len),
+            "heads": int(heads),
+            "head_dim": int(hd),
+            "dtype": str(np.dtype(self._cache_k.dtype).name),
         }
 
     def decode(self, lengths, active, temps, seeds) -> InFlightBatch:

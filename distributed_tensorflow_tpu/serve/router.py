@@ -283,7 +283,8 @@ class Router:
 
     # Door-level counters, guarded by _lock (watched by sanitize_races in
     # tests/test_router.py's pipelining soak).
-    _RACETRACE_ATTRS = ("_closed", "_shed", "_retries", "_door_429")
+    _RACETRACE_ATTRS = ("_closed", "_shed", "_retries", "_door_429",
+                        "_n_probes", "_migrations")
 
     def __init__(
         self,
@@ -327,6 +328,11 @@ class Router:
         self._shed = 0        # door sheds (no routable replica)
         self._door_429 = 0    # door backpressure (fleet in-flight cap)
         self._retries = 0     # failover hops taken
+        self._n_probes = 0    # lifetime health probes (fault-hook clock)
+        self._migrations = 0  # drain-deadline stream migrations triggered
+        # Serving-side chaos (serve/faultinject.py): when set, probe_
+        # timeout events swallow health probes on the probe ordinal clock.
+        self.fault_injector = None
         self._stop = threading.Event()
         self._poll_thread: threading.Thread | None = None
 
@@ -395,6 +401,13 @@ class Router:
         """One /healthz probe OUTSIDE the lock: (alive, body).  Alive
         means "answered with parseable JSON" — a 503 draining/starting
         body is an alive replica that must NOT be restarted."""
+        inj = self.fault_injector
+        if inj is not None:
+            with self._lock:
+                self._n_probes += 1
+                n = self._n_probes
+            if inj.check_probe(n):
+                return False, None  # drill: the probe timed out
         try:
             _, body = _get_json(
                 r.base_url + "/healthz", self.config.poll_timeout_s
@@ -591,8 +604,16 @@ class Router:
                     r.in_flight -= 1
             if code is not None and (code < 500 and code != 429):
                 if code == 200:
+                    if body.get("status") == "migrated":
+                        # A drain-deadline migration moved this stream
+                        # mid-generation: collect the finished result
+                        # from the adopting replica (or replay with the
+                        # generated prefix) before answering the client.
+                        code, body = self._collect_migrated(
+                            rid, path, payload, body, timeout
+                        )
                     body.setdefault("request_id", rid)
-                    body["replica"] = name
+                    body.setdefault("replica", name)
                 return code, body
             tried.add(name)
             attempts += 1
@@ -614,7 +635,109 @@ class Router:
             "shed": True,
         }
 
+    def _collect_migrated(
+        self,
+        rid: str,
+        path: str,
+        payload: dict,
+        body: dict,
+        timeout: float | None,
+    ) -> tuple[int, dict]:
+        """Follow a ``status: "migrated"`` answer to the stream's new
+        home: ``POST /v1/stream_wait`` on the target blocks for the
+        finished generation (the target may itself migrate onward — each
+        hop is followed, bounded like failover). When the target cannot
+        answer — died, never adopted, already handed the result out — the
+        request REPLAYS through normal routing with the client-visible
+        generated prefix as ``resume_tokens``, so retry-after-kill never
+        re-emits or skips a token: the resumed replica re-prefills the
+        prefix at its absolute positions and the accumulated token list
+        comes back bit-identical to an uninterrupted run."""
+        cfg = self.config
+        total = timeout if timeout is not None else cfg.request_timeout_s
+        hops = 0
+        while body.get("status") == "migrated" and hops <= cfg.max_retries:
+            hops += 1
+            target = str(body.get("target", ""))
+            tokens = [int(t) for t in body.get("tokens", ())]
+            deadline = self._clock() + total
+            code, out = None, {}
+            while self._clock() < deadline:
+                try:
+                    code, out = _post_json(
+                        f"http://{target}/v1/stream_wait",
+                        {"request_id": rid, "timeout_s": total},
+                        rid,
+                        total + 5.0,
+                    )
+                except (urllib.error.URLError, TimeoutError, OSError):
+                    code, out = None, {}
+                    break
+                if code != 504:
+                    break  # 504 = still generating: keep waiting
+            if code == 200:
+                body = out  # may be "migrated" again: follow the chain
+                continue
+            # The target can't answer: replay with everything the client
+            # (transitively, this router) has already been shown.
+            replay = dict(payload)
+            if tokens:
+                replay["resume_tokens"] = tokens
+            with self._lock:
+                self._retries += 1
+            logger.info(
+                "request %s: migrated stream unreachable on %s "
+                "(code=%s); replaying with %d resume tokens",
+                rid, target, code, len(tokens),
+            )
+            return self.route(
+                path, replay, request_id=rid, timeout=timeout
+            )
+        return 200, body
+
     # ----------------------------------------------------------- hot swap
+
+    def _migrate_streams(self, victim: Replica) -> dict:
+        """Drain-deadline path: move every live stream off ``victim`` to
+        the surviving routable replicas via its ``POST /migratez``.
+        Raises RuntimeError when no survivor exists or the victim refuses
+        — hot_swap then fails exactly as the old wait-forever path did."""
+        with self._lock:
+            survivors = [
+                r for r in self.replicas
+                if r is not victim and r.routable()
+            ]
+        pairs = []
+        for s in survivors:
+            u = urlparse(s.base_url)
+            pairs.append([u.hostname or "127.0.0.1", int(u.port or 80)])
+        if not pairs:
+            raise RuntimeError(
+                f"hot_swap: {victim.name} did not drain and no survivor "
+                "can adopt its streams"
+            )
+        try:
+            code, body = _post_json(
+                victim.base_url + "/migratez", {"targets": pairs},
+                f"migrate-{victim.name}", self.config.request_timeout_s,
+            )
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise RuntimeError(
+                f"hot_swap: stream migration off {victim.name} failed: {e}"
+            ) from e
+        if code != 200:
+            raise RuntimeError(
+                f"hot_swap: stream migration off {victim.name} refused: "
+                f"HTTP {code} {body}"
+            )
+        with self._lock:
+            self._migrations += 1
+        logger.info(
+            "migrated %d live streams off %s (%d to survivors, "
+            "%d re-adopted)", body.get("exported", 0), victim.name,
+            body.get("migrated", 0), body.get("readopted", 0),
+        )
+        return body
 
     def _wait_drained(self, r: Replica, deadline: float) -> bool:
         """Poll the draining replica until queued + in-flight work hits
@@ -700,10 +823,27 @@ class Router:
                 if not self._wait_drained(
                     r, self._clock() + cfg.drain_timeout_s
                 ):
-                    raise RuntimeError(
-                        f"hot_swap: {r.name} did not drain within "
-                        f"{cfg.drain_timeout_s}s"
+                    # Drain deadline (ISSUE 18): instead of waiting out
+                    # the longest generation (unbounded with a large
+                    # max_new_tokens), move the remaining live streams to
+                    # the survivors and proceed with the swap. The
+                    # victim-held responses come back "migrated" and the
+                    # router's route() collects them from their new homes.
+                    mig = self._migrate_streams(r)
+                    self.recorder.record(
+                        "hot_swap", replica=r.name, stage="migrate",
+                        exported=mig.get("exported", 0),
+                        migrated=mig.get("migrated", 0),
+                        readopted=mig.get("readopted", 0),
                     )
+                    if not self._wait_drained(
+                        r, self._clock() + cfg.drain_timeout_s
+                    ):
+                        raise RuntimeError(
+                            f"hot_swap: {r.name} did not drain within "
+                            f"{cfg.drain_timeout_s}s even after migrating "
+                            f"{mig.get('migrated', 0)} streams"
+                        )
                 self._stop_proc(r)
                 r.cmd = list(make_cmd(r))
                 self._launch(r)
@@ -766,6 +906,7 @@ class Router:
                 "retries": self._retries,
                 "shed": self._shed,
                 "door_429": self._door_429,
+                "stream_migrations": self._migrations,
                 "closed": self._closed,
             }
         return out
@@ -903,15 +1044,20 @@ def build_router_server(
         def do_POST(self):
             url = urlparse(self.path)
             if url.path == "/drainz":
+                progress = {}
                 for r in list(router.replicas):
                     try:
-                        _post_json(
+                        _, b = _post_json(
                             r.base_url + "/drainz", {}, "router-drain",
                             router.config.poll_timeout_s,
                         )
+                        # Per-replica drain progress (slots_active,
+                        # queued, tokens_remaining): the operator sees
+                        # why the fleet drain is slow, per replica.
+                        progress[r.name] = b.get("progress")
                     except (urllib.error.URLError, TimeoutError, OSError):
-                        pass  # a dead replica is already drained
-                self._reply(200, {"draining": True})
+                        progress[r.name] = None  # dead = already drained
+                self._reply(200, {"draining": True, "progress": progress})
                 return
             if not url.path.startswith("/v1/"):
                 self._reply(404, {"error": f"no route {url.path}"})

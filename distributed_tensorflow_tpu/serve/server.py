@@ -61,6 +61,7 @@ import itertools
 import json
 import logging
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -312,6 +313,8 @@ def build_http_server(
     trace_dir: str | None = None,
     kv_receiver=None,
     transfer_budget=None,
+    stream_receiver=None,
+    migrator=None,
 ):
     """Build (not start) a ``ThreadingHTTPServer`` over ``client``.
 
@@ -326,6 +329,18 @@ def build_http_server(
     a ``WireError`` refusal, 429 on a budget shed — and ``transfer_budget``
     (a :class:`~distributed_tensorflow_tpu.serve.disagg.TransferBudget`)
     to surface the bytes-in-flight digest under ``/statusz``.
+
+    Live stream migration (ISSUE 18) adds two more optional mounts:
+    ``stream_receiver`` (a
+    :class:`~distributed_tensorflow_tpu.serve.disagg.StreamReceiver`)
+    mounts ``POST /v1/stream_migrate`` — same octet-stream/400/429
+    contract as kv_transfer — plus ``POST /v1/stream_wait`` (JSON
+    ``{"request_id": ..}``) which blocks for an adopted stream's finished
+    generation and 404s for unknown ids (the caller's cue to replay with
+    ``resume_tokens``). ``migrator`` (a ``targets -> dict`` callable
+    wrapping :func:`~distributed_tensorflow_tpu.serve.disagg.migrate_streams`)
+    mounts ``POST /migratez`` — export every live stream here and push
+    them to the given ``[[host, port], ..]`` survivors.
     """
 
     class Handler(BaseHTTPRequestHandler):
@@ -336,7 +351,13 @@ def build_http_server(
             "/v1/mlm": ("pred_ids", "score", "nsp_probs", "bucket"),
             "/v1/embed": ("embedding", "bucket"),
             "/v1/classify": ("top_ids", "top_probs"),
-            "/v1/generate": ("tokens", "n_tokens", "prompt_len", "bucket"),
+            # status/target surface ONLY when a drain-with-deadline
+            # migrated the stream away mid-generation: the router sees
+            # status == "migrated" and collects the finished stream from
+            # the target via /v1/stream_wait (ordinary results carry
+            # neither key, so clients see no change).
+            "/v1/generate": ("tokens", "n_tokens", "prompt_len", "bucket",
+                             "status", "target"),
         }
 
         def log_message(self, fmt, *args):  # route access logs into logging
@@ -395,6 +416,10 @@ def build_http_server(
                 **(
                     {"kv_transfer": transfer_budget.digest()}
                     if transfer_budget is not None else {}
+                ),
+                **(
+                    {"stream_migrate": stream_receiver.digest()}
+                    if stream_receiver is not None else {}
                 ),
             }
 
@@ -504,10 +529,128 @@ def build_http_server(
                 else:
                     self._reply(200, out)
                 return
+            if url.path == "/v1/stream_migrate":
+                if stream_receiver is None:
+                    self._reply(
+                        503,
+                        {"error": "stream migration disabled: server built "
+                                  "without a stream receiver"},
+                    )
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    out = stream_receiver(self.rfile.read(n))
+                except ValueError as e:  # WireError: refuse, don't adopt
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — budget shed or adoption failure
+                    retry = getattr(e, "retry_after_s", None)
+                    if retry is not None:
+                        self._reply(
+                            429,
+                            {"error": str(e), "retry_after_s": retry},
+                            headers={"Retry-After": f"{retry:.3f}"},
+                        )
+                    else:
+                        logger.exception("stream migrate failed")
+                        client.recorder.record(
+                            "server_error", "", error=type(e).__name__,
+                        )
+                        self._reply(500, {"error": str(e)})
+                else:
+                    self._reply(200, out)
+                return
+            if url.path == "/v1/stream_wait":
+                if stream_receiver is None:
+                    self._reply(
+                        503,
+                        {"error": "stream migration disabled: server built "
+                                  "without a stream receiver"},
+                    )
+                    return
+                rid = None
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    rid = payload.get("request_id")
+                    if not rid:
+                        self._reply(
+                            400, {"error": "stream_wait needs a request_id"}
+                        )
+                        return
+                    result = stream_receiver.wait(
+                        rid, float(payload.get("timeout_s", 60.0))
+                    )
+                except json.JSONDecodeError as e:
+                    self._reply(400, {"error": f"bad JSON: {e}"})
+                except KeyError:
+                    # Unknown id: this replica never adopted the stream
+                    # (or already handed its result out) — the caller's
+                    # cue to replay with resume_tokens.
+                    self._reply(
+                        404,
+                        {"error": f"no adopted stream {rid!r} here",
+                         "request_id": rid},
+                    )
+                except (FutureTimeout, TimeoutError):
+                    self._reply(
+                        504,
+                        {"error": "stream still generating",
+                         "request_id": rid},
+                    )
+                except Exception as e:  # noqa: BLE001 — the resumed stream failed
+                    logger.exception("stream_wait %s failed", rid)
+                    self._reply(500, {"error": str(e), "request_id": rid})
+                else:
+                    fields = self._routes["/v1/generate"]
+                    body = {k: result[k] for k in fields if k in result}
+                    body["request_id"] = rid
+                    self._reply(200, body)
+                return
+            if url.path == "/migratez":
+                if migrator is None:
+                    self._reply(
+                        503,
+                        {"error": "stream migration disabled: server built "
+                                  "without a migrator"},
+                    )
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    targets = [
+                        (str(t[0]), int(t[1]))
+                        for t in payload.get("targets", ())
+                    ]
+                    out = migrator(targets)
+                except (ValueError, TypeError, IndexError,
+                        json.JSONDecodeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    logger.exception("stream migration failed")
+                    client.recorder.record(
+                        "server_error", "", error=type(e).__name__,
+                    )
+                    self._reply(500, {"error": str(e)})
+                else:
+                    self._reply(200, out)
+                return
             if url.path == "/drainz":
                 client.start_draining()
                 code, body = client.health.probe()
-                self._reply(200, {"draining": True, **body})
+                # Drain progress (ISSUE 18 satellite): why is this drain
+                # slow, and how much work remains — the router reads the
+                # same numbers to decide migrate-vs-wait.
+                st = client.batcher.status()
+                self._reply(200, {
+                    "draining": True,
+                    "progress": {
+                        "slots_active": st.get("slots_active", 0),
+                        "queued": st.get("queue_depth", 0),
+                        "in_flight": st.get("in_flight", 0),
+                        "tokens_remaining": st.get("tokens_remaining", 0),
+                    },
+                    **body,
+                })
                 return
             if url.path == "/debugz/dump":
                 if not client.recorder.enabled:

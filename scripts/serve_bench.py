@@ -107,6 +107,28 @@ TTFT p50 ratio is recorded in docs/PERF.md round 16.
 
     python scripts/serve_bench.py --fleet --quick   # CI chaos gate
     python scripts/serve_bench.py --fleet           # + affinity A/B
+
+Migration mode (--migrate, ISSUE 18) is the live decode-stream migration
+gate: two REAL migration-enabled engines in-process plus one subprocess
+replica (this script re-entered), all fronted by an adopt-mode router,
+with every decode step paced by a seeded ``slow_decode_step`` fault plan
+so streams are genuinely mid-generation when the drills land. Three
+drills: (1) kill — streams migrate onto the subprocess replica which is
+then SIGKILLed before they finish, so the router's stream_wait fails and
+each stream REPLAYS with its ``resume_tokens`` prefix; (2) drain-migrate
+— a draining victim's live streams export over the v2 wire onto the
+survivor and the victim's drain wall is measured; (3) drain-and-wait —
+the same load drains naturally (the baseline hot-swap pays). Gates,
+correctness accumulated unconditionally across attempts: every stream
+bit-identical to its uninterrupted reference (zero tokens lost or
+duplicated), at least one stream actually migrated per drill, at least
+one replay retry after the kill, and the migrate-drain wall strictly
+below BOTH the longest stream's natural completion and the
+drain-and-wait baseline (the victim is freed without waiting out the
+longest generation).
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py --migrate --quick
+    python scripts/serve_bench.py --migrate
 """
 
 from __future__ import annotations
@@ -2301,6 +2323,558 @@ def run_fleet(args) -> int:
     return 0
 
 
+# --------------------------------------------------------------------------
+# Live decode-stream migration drill (--migrate, ISSUE 18)
+
+
+def _migrate_geo(quick: bool) -> dict:
+    """Shared geometry for the in-process engines and the re-entered
+    subprocess replica: long generations (the migration regime) on a
+    tiny model, decode paced by ``slow_s`` per step so streams are
+    reliably mid-generation when a drill lands."""
+    if quick:
+        return dict(hidden=32, layers=2, heads=2, maxpos=96,
+                    buckets=(8, 16), slots=3, max_batch=2, max_new=24,
+                    mb=0.25, bt=4, chunk=8, n=8, slow_s=0.03,
+                    pace_steps=4000)
+    return dict(hidden=64, layers=3, heads=4, maxpos=192,
+                buckets=(16, 32), slots=4, max_batch=2, max_new=40,
+                mb=1.0, bt=8, chunk=16, n=12, slow_s=0.03,
+                pace_steps=8000)
+
+
+def _mig_http(url: str, payload: dict | None = None, timeout_s: float = 60.0):
+    """Tiny JSON helper: POST when ``payload`` is given, GET otherwise.
+    Returns (code, body) and treats HTTP errors (a draining replica's
+    503 /healthz) as answers, not exceptions."""
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read() or b"{}")
+        finally:
+            e.close()
+
+
+def _wait_drained_url(base: str, deadline_s: float) -> float | None:
+    """Poll ``base``/healthz until queued + in-flight + active slots hit
+    zero (the router's drain criterion); returns the wall seconds it
+    took, or None on deadline."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            _, body = _mig_http(base + "/healthz", timeout_s=5.0)
+        except OSError:
+            body = {}
+        if body and (
+            body.get("queue_depth", 0) + body.get("in_flight", 0)
+            + body.get("slots_active", 0)
+        ) == 0:
+            return time.monotonic() - t0
+        time.sleep(0.02)
+    return None
+
+
+def run_migrate_replica(args) -> int:
+    """Re-entered child: one migration-enabled replica server (the kill
+    target / survivor of the --migrate drills). Same params as the
+    parent's in-process engines (PRNGKey(0) init is deterministic), the
+    stream receiver and /migratez migrator mounted, decode paced by
+    ``--replica-fault-plan``."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        CausalLMEngine,
+        Client,
+        TransferBudget,
+    )
+    from distributed_tensorflow_tpu.serve.disagg import (
+        make_stream_receiver,
+        migrate_streams,
+    )
+    from distributed_tensorflow_tpu.serve.faultinject import (
+        FaultInjector,
+        FaultPlan,
+    )
+    from distributed_tensorflow_tpu.serve.server import build_http_server
+
+    geo = _migrate_geo(args.quick)
+    cfg = CausalLMConfig(
+        vocab_size=64, hidden_size=geo["hidden"],
+        num_layers=geo["layers"], num_heads=geo["heads"],
+        intermediate_size=4 * geo["hidden"], max_position=geo["maxpos"],
+    )
+    model = CausalLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+        jnp.ones((1, 8), bool),
+    )["params"]
+    engine = CausalLMEngine(
+        model, params, buckets=geo["buckets"], slots=geo["slots"],
+        max_batch=geo["max_batch"], max_new_tokens=geo["max_new"],
+        prefix_cache_mb=geo["mb"], block_tokens=geo["bt"],
+        prefill_chunk=geo["chunk"], stream_migrate=True,
+    )
+    client = Client(
+        engine,
+        BatcherConfig(max_batch=geo["max_batch"], max_queue=256,
+                      max_in_flight=2),
+        recorder=FlightRecorder(capacity=2048),
+        tag=args.replica_tag,
+    )
+    if args.replica_fault_plan:
+        client.batcher.fault_injector = FaultInjector(
+            FaultPlan.parse(
+                args.replica_fault_plan, num_steps=geo["pace_steps"]
+            ),
+            recorder=client.recorder,
+        )
+    budget = TransferBudget(64 * 1024 * 1024)
+    receiver = make_stream_receiver(
+        client.batcher, engine, budget=budget,
+        metrics=client.metrics, recorder=client.recorder,
+    )
+
+    def migrator(targets):
+        return migrate_streams(
+            client.batcher, engine, targets,
+            metrics=client.metrics, recorder=client.recorder,
+            fault_injector=client.batcher.fault_injector,
+        )
+
+    server = build_http_server(
+        client, port=args.replica_serve, stream_receiver=receiver,
+        migrator=migrator, transfer_budget=budget,
+    )
+    print(f"READY {server.server_address[1]}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        client.close()
+    return 0
+
+
+def _run_migrate_drills(args) -> dict:
+    """The three --migrate drills over two in-process migration-enabled
+    engines (A, B) plus one subprocess replica (V, the kill target),
+    all behind an adopt-mode router. Returns the measured result dict;
+    correctness counters accumulate across retried rounds."""
+    import subprocess
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        CausalLMEngine,
+        Client,
+        TransferBudget,
+    )
+    from distributed_tensorflow_tpu.serve.disagg import (
+        make_stream_receiver,
+        migrate_streams,
+    )
+    from distributed_tensorflow_tpu.serve.faultinject import (
+        FaultInjector,
+        FaultPlan,
+    )
+    from distributed_tensorflow_tpu.serve.router import Router, RouterConfig
+    from distributed_tensorflow_tpu.serve.server import build_http_server
+
+    geo = _migrate_geo(args.quick)
+    pace_spec = (f"seed=5,slow_decode_step={geo['pace_steps']},"
+                 f"slow_step_s={geo['slow_s']}")
+
+    # The subprocess replica warms its AOT grid while we build ours.
+    me = os.path.abspath(__file__)
+    vport = _free_ports(1)[0]
+    vcmd = [sys.executable, me, "--migrate", "--replica-serve", str(vport),
+            "--replica-tag", "migrate-v1", "--replica-fault-plan", pace_spec]
+    if args.quick:
+        vcmd.append("--quick")
+    vproc = subprocess.Popen(
+        vcmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
+    )
+
+    cfg = CausalLMConfig(
+        vocab_size=64, hidden_size=geo["hidden"],
+        num_layers=geo["layers"], num_heads=geo["heads"],
+        intermediate_size=4 * geo["hidden"], max_position=geo["maxpos"],
+    )
+    model = CausalLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+        jnp.ones((1, 8), bool),
+    )["params"]
+    eng_kw = dict(
+        buckets=geo["buckets"], slots=geo["slots"],
+        max_batch=geo["max_batch"], max_new_tokens=geo["max_new"],
+        prefix_cache_mb=geo["mb"], block_tokens=geo["bt"],
+        prefill_chunk=geo["chunk"], stream_migrate=True,
+    )
+    clients, servers, threads = {}, {}, []
+    for name in ("mig-a", "mig-b"):
+        engine = CausalLMEngine(model, params, **eng_kw)
+        c = Client(
+            engine,
+            BatcherConfig(max_batch=geo["max_batch"], max_queue=256,
+                          max_in_flight=2),
+            recorder=FlightRecorder(capacity=4096),
+            tag="migrate-v1",
+        )
+        budget = TransferBudget(64 * 1024 * 1024)
+        receiver = make_stream_receiver(
+            c.batcher, engine, budget=budget,
+            metrics=c.metrics, recorder=c.recorder,
+        )
+
+        def migrator(targets, c=c, engine=engine):
+            return migrate_streams(
+                c.batcher, engine, targets,
+                metrics=c.metrics, recorder=c.recorder,
+                fault_injector=c.batcher.fault_injector,
+            )
+
+        srv = build_http_server(
+            c, port=0, stream_receiver=receiver, migrator=migrator,
+            transfer_budget=budget,
+        )
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        clients[name], servers[name] = c, srv
+        threads.append((srv, t))
+
+    urls = {
+        name: f"http://127.0.0.1:{srv.server_address[1]}"
+        for name, srv in servers.items()
+    }
+    urls["mig-v"] = f"http://127.0.0.1:{vport}"
+    router = Router(
+        [(name, urls[name], None) for name in
+         ("mig-a", "mig-b", "mig-v")],
+        RouterConfig(
+            poll_interval_s=0.1, poll_timeout_s=2.0, start_grace_s=300.0,
+            fail_threshold=2, max_retries=3, request_timeout_s=120.0,
+            affinity_tokens=0, max_in_flight_per_replica=64,
+            ready_timeout_s=300.0, drain_timeout_s=30.0, seed=7,
+        ),
+        recorder=FlightRecorder(capacity=2048),
+    )
+    router.start()
+
+    def arm(name: str, seed: int) -> None:
+        c = clients[name]
+        c.batcher.fault_injector = FaultInjector(
+            FaultPlan.generate(
+                seed, geo["pace_steps"],
+                {"slow_decode_step": geo["pace_steps"]},
+                slow_step_s=geo["slow_s"],
+            ),
+            recorder=c.recorder,
+        )
+
+    def disarm(name: str) -> None:
+        clients[name].batcher.fault_injector = None
+
+    def busiest(names) -> str:
+        fz = {r["name"]: r["in_flight"]
+              for r in router.fleetz()["replicas"]}
+        return max(names, key=lambda n: fz.get(n, 0))
+
+    def submit_round(payloads):
+        """Route every payload concurrently; returns (rows, joiner).
+        ``joiner()`` blocks for completion and returns the rows."""
+        rows: list[dict | None] = [None] * len(payloads)
+
+        def one(i: int) -> None:
+            code, body = router.route("/v1/generate", dict(payloads[i]))
+            rows[i] = {"code": code, "tokens": body.get("tokens"),
+                       "replica": body.get("replica")}
+
+        ts = [threading.Thread(target=one, args=(i,))
+              for i in range(len(payloads))]
+        for t in ts:
+            t.start()
+
+        def joiner():
+            for t in ts:
+                t.join(timeout=300)
+            return rows
+
+        return rows, joiner
+
+    rng = np.random.default_rng(11)
+    payloads = [
+        {
+            "input_ids": [int(x) for x in
+                          rng.integers(5, 64, size=int(rng.integers(6, 13)))],
+            "max_new_tokens": geo["max_new"],
+            "seed": i,
+        }
+        for i in range(geo["n"])
+    ]
+
+    parity_failures = 0
+    lost = 0
+
+    def score(rows, reference) -> None:
+        nonlocal parity_failures, lost
+        for i, r in enumerate(rows):
+            if r is None or r["code"] != 200:
+                lost += 1
+            elif r["tokens"] != reference[i]:
+                parity_failures += 1
+
+    result: dict = {"geometry": {k: list(v) if isinstance(v, tuple) else v
+                                 for k, v in geo.items()}}
+    try:
+        if not router.wait_ready(timeout=300.0):
+            raise RuntimeError(
+                "migrate fleet did not come up: "
+                + ", ".join(f"{r.name}={r.state}" for r in router.replicas)
+            )
+        print(f"# migrate fleet up: {urls} (V pid {vproc.pid})")
+
+        # Uninterrupted reference: direct unpaced posts to A (streams are
+        # deterministic functions of (seed, prompt) — replica-agnostic).
+        reference = []
+        for p in payloads:
+            code, body = _mig_http(
+                urls["mig-a"] + "/v1/generate", dict(p), timeout_s=120.0
+            )
+            if code != 200:
+                raise RuntimeError(f"reference request failed: {code} {body}")
+            reference.append(body["tokens"])
+
+        host = "127.0.0.1"
+
+        # ---- drill 1: migrate onto V, SIGKILL V, replay with resume.
+        retries_before = router.fleetz()["retries"]
+        kill = None
+        for attempt in range(3):
+            arm("mig-a", 21 + attempt)
+            arm("mig-b", 22 + attempt)
+            rows, join = submit_round(payloads)
+            time.sleep(0.45)
+            victim = busiest(("mig-a", "mig-b"))
+            code, mig = _mig_http(
+                urls[victim] + "/migratez",
+                {"targets": [[host, vport]]}, timeout_s=60.0,
+            )
+            if code == 200 and mig.get("migrated", 0) >= 1:
+                vproc.kill()  # before V finishes the adopted streams
+                kill = {"victim": victim, "migratez": mig,
+                        "rows": join()}
+                score(kill["rows"], reference)
+                break
+            # Vacuous round (victim idle / nothing migrated): let it
+            # finish — parity still gates — and try again. V was NOT
+            # killed, so another round is possible.
+            print(f"# kill drill attempt {attempt + 1}: nothing migrated "
+                  f"off {victim} (HTTP {code}); retrying")
+            score(join(), reference)
+        if kill is None:
+            raise RuntimeError(
+                "kill drill: no attempt migrated a live stream onto V"
+            )
+        vproc.wait(timeout=30)
+        kill["retries"] = router.fleetz()["retries"] - retries_before
+        print(f"# kill drill: {kill['migratez']['migrated']} streams "
+              f"migrated onto V, V SIGKILLed, {kill['retries']} router "
+              f"retries (stream_wait failures replayed with resume "
+              f"prefix)")
+
+        # ---- drill 2: drain-migrate — victim freed by migration while
+        # the SAME streams finish on the survivor (the intrinsic
+        # drain-and-wait comparison, zero load mismatch).
+        drain = None
+        for attempt in range(3):
+            arm("mig-a", 31 + attempt)
+            arm("mig-b", 32 + attempt)
+            rows, join = submit_round(payloads)
+            time.sleep(0.45)
+            victim = busiest(("mig-a", "mig-b"))
+            survivor = "mig-b" if victim == "mig-a" else "mig-a"
+            fz = {r["name"]: r["in_flight"]
+                  for r in router.fleetz()["replicas"]}
+            if fz.get(victim, 0) < 1:
+                print(f"# drain drill attempt {attempt + 1}: victim "
+                      f"{victim} idle; retrying")
+                score(join(), reference)
+                continue
+            t0 = time.monotonic()
+            _mig_http(urls[victim] + "/drainz", {}, timeout_s=10.0)
+            code, mig = _mig_http(
+                urls[victim] + "/migratez",
+                {"targets": [[host, servers[survivor].server_address[1]]]},
+                timeout_s=60.0,
+            )
+            if code != 200:
+                raise RuntimeError(f"/migratez on {victim} failed: "
+                                   f"{code} {mig}")
+            wall_migrate = _wait_drained_url(urls[victim], 60.0)
+            rows = join()
+            wall_complete = time.monotonic() - t0
+            score(rows, reference)
+            if wall_migrate is None:
+                raise RuntimeError(f"{victim} did not drain after "
+                                   "migrating its streams")
+            drain = {"victim": victim, "survivor": survivor,
+                     "migratez": mig, "wall_migrate_s": wall_migrate,
+                     "wall_complete_s": wall_complete, "rows": rows}
+            break
+        if drain is None:
+            raise RuntimeError(
+                "drain drill: victim was never holding a live stream"
+            )
+        print(f"# drain-migrate: {drain['migratez']['migrated']} streams "
+              f"off {drain['victim']}; victim drained in "
+              f"{drain['wall_migrate_s']:.2f}s, longest stream finished "
+              f"on {drain['survivor']} at {drain['wall_complete_s']:.2f}s")
+
+        # ---- drill 3: drain-and-wait baseline on the survivor (never
+        # drained so far): same paced load, /drainz only, the drain wall
+        # IS the longest stream's natural completion.
+        waiter = drain["survivor"]
+        arm(waiter, 41)
+        rows, join = submit_round(payloads)
+        time.sleep(0.45)
+        t0 = time.monotonic()
+        _mig_http(urls[waiter] + "/drainz", {}, timeout_s=10.0)
+        wall_wait = _wait_drained_url(urls[waiter], 120.0)
+        score(join(), reference)
+        if wall_wait is None:
+            raise RuntimeError(f"{waiter} never finished its natural drain")
+        print(f"# drain-and-wait baseline: {waiter} drained naturally in "
+              f"{wall_wait:.2f}s")
+
+        # Observability: the drills must leave the post-mortem trail.
+        events: dict[str, int] = {}
+        migrations: dict[str, int] = {}
+        for c in clients.values():
+            for e in c.recorder.events():
+                k = e["kind"]
+                if k.startswith("stream_"):
+                    events[k] = events.get(k, 0) + 1
+            for outcome, v in (
+                c.metrics.snapshot().get("stream_migrations") or {}
+            ).items():
+                migrations[outcome] = migrations.get(outcome, 0) + v
+
+        result.update({
+            "requests_per_round": geo["n"],
+            "reference_tokens": sum(len(t) for t in reference),
+            "kill": {k: v for k, v in kill.items() if k != "rows"},
+            "drain": {k: v for k, v in drain.items() if k != "rows"},
+            "wall_wait_s": wall_wait,
+            "parity_failures": parity_failures,
+            "lost": lost,
+            "stream_events": events,
+            "stream_migrations": migrations,
+            "router": {k: v for k, v in router.fleetz().items()
+                       if k != "replicas"},
+        })
+        return result
+    finally:
+        if vproc.poll() is None:
+            vproc.kill()
+        router.close()
+        for srv, t in threads:
+            srv.shutdown()
+            srv.server_close()
+            t.join(timeout=10)
+        for c in clients.values():
+            c.batcher.fault_injector = None
+            c.close()
+
+
+def run_migrate(args) -> int:
+    """The --migrate gate: live-stream migration drills (kill + drain)
+    with unconditional bit-parity, plus the hot-swap drain-wall A/B."""
+    print("# migrate drill: 2 in-process migration-enabled engines + 1 "
+          "subprocess replica behind an adopt-mode router; paced decode")
+    res = _run_migrate_drills(args)
+
+    k, d = res["kill"], res["drain"]
+    hdr = (f"{'drill':>14} {'migrated':>9} {'readopted':>10} "
+           f"{'drain wall s':>13} {'complete s':>11}")
+    print("\n" + hdr)
+    print("-" * len(hdr))
+    print(f"{'kill+replay':>14} {k['migratez']['migrated']:>9d} "
+          f"{k['migratez'].get('readopted', 0):>10d} {'-':>13} {'-':>11}")
+    print(f"{'drain-migrate':>14} {d['migratez']['migrated']:>9d} "
+          f"{d['migratez'].get('readopted', 0):>10d} "
+          f"{d['wall_migrate_s']:>13.2f} {d['wall_complete_s']:>11.2f}")
+    print(f"{'drain-and-wait':>14} {'-':>9} {'-':>10} "
+          f"{res['wall_wait_s']:>13.2f} {res['wall_wait_s']:>11.2f}")
+    ratio = (res["wall_wait_s"] / d["wall_migrate_s"]
+             if d["wall_migrate_s"] else float("inf"))
+    print(f"# victim freed {ratio:.1f}x faster than drain-and-wait; "
+          f"{k['retries']} replay retries after the kill")
+    print(f"# stream events: {res['stream_events']}; migrations by "
+          f"outcome: {res['stream_migrations']}")
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump({"mode": "migrate", **res}, fh, indent=2)
+        print(f"# wrote {args.json}")
+
+    # Correctness gates: unconditional, accumulated across every round.
+    if res["parity_failures"] or res["lost"]:
+        print(f"FAIL: {res['parity_failures']} migrated/replayed streams "
+              f"diverged from their uninterrupted reference and "
+              f"{res['lost']} requests were lost — migration must never "
+              "lose or duplicate a token", file=sys.stderr)
+        return 1
+    if k["retries"] < 1:
+        print("FAIL: killing the migration target produced no replay "
+              "retry — the resume-with-prefix path never ran",
+              file=sys.stderr)
+        return 1
+    if not (res["stream_events"].get("stream_export")
+            and res["stream_events"].get("stream_adopt")):
+        print(f"FAIL: flight recorder missing stream migration events "
+              f"(got {res['stream_events']})", file=sys.stderr)
+        return 1
+    if d["wall_migrate_s"] >= d["wall_complete_s"]:
+        print(f"FAIL: migrate drain wall {d['wall_migrate_s']:.2f}s did "
+              f"not beat the longest stream's completion "
+              f"{d['wall_complete_s']:.2f}s — the victim waited anyway",
+              file=sys.stderr)
+        return 1
+    if d["wall_migrate_s"] >= res["wall_wait_s"]:
+        print(f"FAIL: migrate drain wall {d['wall_migrate_s']:.2f}s not "
+              f"below the drain-and-wait baseline "
+              f"{res['wall_wait_s']:.2f}s", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _print_grid_summary(grid: dict) -> None:
     """The one-line AOT-grid digest (/compilez over the bench engine) so
     PERF.md rounds can attribute warmup cost."""
@@ -2558,6 +3132,14 @@ def main(argv=None) -> int:
                    help="replicated-router chaos drill: N real replica "
                    "processes behind serve/router.py, a seeded mid-trace "
                    "SIGKILL, and a rolling hot-swap (round 16)")
+    p.add_argument("--migrate", action="store_true",
+                   help="live decode-stream migration drills (ISSUE 18): "
+                   "kill + drain migrations with unconditional bit-parity "
+                   "against uninterrupted references, plus the hot-swap "
+                   "drain-wall A/B vs drain-and-wait")
+    p.add_argument("--replica-fault-plan", default="",
+                   help="internal: fault plan armed inside a re-entered "
+                   "replica (paces its decode steps)")
     p.add_argument("--fleet-replicas", type=int, default=3,
                    help="replica processes in the fleet")
     p.add_argument("--fleet-requests", type=int, default=60,
@@ -2618,6 +3200,10 @@ def main(argv=None) -> int:
         return run_fleet_replica(args)
     if args.fleet:
         return run_fleet(args)
+    if args.migrate and args.replica_serve:
+        return run_migrate_replica(args)
+    if args.migrate:
+        return run_migrate(args)
     if args.decode:
         return run_decode(args)
     if args.disagg:

@@ -1,0 +1,833 @@
+"""Live decode-stream migration (serve/disagg.py v2 stream wire +
+serve/faultinject.py, ISSUE 18): wire round-trip bit-exactness and every
+refusal path, the fault-plan/injector chaos surface, export/adopt parity
+against the full-forward greedy reference on one chip and tp2 (composed
+with prefix cache, chunked prefill, and speculation), the HTTP routes
+(``/v1/stream_migrate``, ``/v1/stream_wait``, ``/drainz`` progress,
+``X-Request-Id`` correlation), and the degradation ladder: wire
+corruption falls back page-less, a dead survivor re-adopts locally —
+zero lost or duplicated tokens either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.serve.batcher import (
+    Backpressure,
+    StreamState,
+)
+from distributed_tensorflow_tpu.serve.disagg import (
+    _PREFIX,
+    WIRE_VERSION,
+    WIRE_VERSION_STREAM,
+    TransferBudget,
+    WireError,
+    _chain_future,
+    deserialize_chain,
+    deserialize_stream,
+    make_stream_receiver,
+    migrate_streams,
+    serialize_chain,
+    serialize_stream,
+)
+from distributed_tensorflow_tpu.serve.faultinject import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+)
+
+# Pure-wire geometry (no engine): head_dim 3 keeps payloads tiny.
+SMETA = {"num_layers": 2, "cache_len": 24, "heads": 2, "head_dim": 3,
+         "dtype": "float32"}
+# v1 chain geometry for the cross-version refusal test.
+CMETA = {"num_layers": 2, "block_tokens": 4, "heads": 2, "head_dim": 3,
+         "dtype": "float32", "max_chain": 8}
+
+MAX_NEW = 12
+
+
+def _state(n_prompt=6, n_gen=3, rid="mig-wire-1", **kw):
+    rng = np.random.default_rng(n_prompt * 31 + n_gen)
+    d = dict(
+        request_id=rid,
+        input_ids=[int(t) for t in rng.integers(5, 60, size=n_prompt)],
+        tokens=[int(t) for t in rng.integers(5, 60, size=n_gen)],
+        seed=3,
+        temperature=0.0,
+        eos_id=None,
+        max_new_tokens=8,
+        length=n_prompt + n_gen - 1,
+    )
+    d.update(kw)
+    return StreamState(**d)
+
+
+def _stages(meta=SMETA, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (meta["num_layers"], meta["cache_len"], meta["heads"],
+             meta["head_dim"])
+    pk = rng.standard_normal(shape).astype(meta["dtype"])
+    pv = rng.standard_normal(shape).astype(meta["dtype"])
+    return pk, pv
+
+
+def _retag(buf: bytes, **patch) -> bytes:
+    """Re-write header fields of a wire buffer (tamper helper): the CRC
+    covers state + payload, NOT the envelope fields, so envelope checks
+    must each refuse on their own."""
+    magic, version, hlen = _PREFIX.unpack_from(buf)
+    header = json.loads(buf[_PREFIX.size:_PREFIX.size + hlen])
+    header.update(patch)
+    hb = json.dumps(header, separators=(",", ":")).encode()
+    return _PREFIX.pack(magic, version, len(hb)) + hb \
+        + buf[_PREFIX.size + hlen:]
+
+
+# ------------------------------------------------------ stream wire format
+
+
+def test_stream_wire_round_trip_paged_bit_exact():
+    st = _state(n_prompt=8, n_gen=4)  # length 11
+    pk, pv = _stages()
+    buf = serialize_stream(st, pk, pv, SMETA)
+    sd, k2, v2, header = deserialize_stream(buf)
+    assert sd == st.to_dict()
+    assert header["n_tokens"] == st.length == 11
+    # Bit-exactness of exactly the settled positions: the receiver scat-
+    # ters the very bytes the victim's cache held, padded, never recast.
+    assert k2.tobytes() == np.ascontiguousarray(pk[:, :11]).tobytes()
+    assert v2.tobytes() == np.ascontiguousarray(pv[:, :11]).tobytes()
+    assert StreamState.from_dict(sd).to_dict() == st.to_dict()
+
+
+def test_stream_wire_round_trip_page_less():
+    st = _state(n_prompt=5, n_gen=2)
+    buf = serialize_stream(st)
+    sd, pk, pv, header = deserialize_stream(buf)
+    assert sd == st.to_dict() and pk is None and pv is None
+    assert header["n_tokens"] == 0 and header["page_meta"] == {}
+
+
+def test_stream_wire_refuses_truncation():
+    buf = serialize_stream(_state(), *_stages(), SMETA)
+    with pytest.raises(WireError, match="prefix"):
+        deserialize_stream(buf[:6])
+    with pytest.raises(WireError, match="truncated header"):
+        deserialize_stream(buf[:_PREFIX.size + 2])
+    with pytest.raises(WireError, match="payload"):
+        deserialize_stream(buf[:-40])
+
+
+def test_stream_wire_refuses_bad_magic_and_corrupt_header():
+    buf = serialize_stream(_state(), *_stages(), SMETA)
+    with pytest.raises(WireError, match="magic"):
+        deserialize_stream(b"NOPE" + buf[4:])
+    corrupt = bytearray(buf)
+    corrupt[_PREFIX.size + 1] = 0xFF  # inside the JSON header
+    with pytest.raises(WireError):
+        deserialize_stream(bytes(corrupt))
+
+
+def test_stream_wire_versions_do_not_cross():
+    """A v1 chain buffer must not parse as a v2 stream, nor the reverse:
+    both sides refuse on version BEFORE trusting any header byte."""
+    rng = np.random.default_rng(0)
+    shape = (2, 2, 4, 2, 3)
+    ids = [int(t) for t in rng.integers(5, 60, size=8)]
+    chain = serialize_chain(ids, rng.standard_normal(shape).astype("f4"),
+                            rng.standard_normal(shape).astype("f4"), CMETA)
+    with pytest.raises(WireError, match="version"):
+        deserialize_stream(chain)
+    stream = serialize_stream(_state(), *_stages(), SMETA)
+    with pytest.raises(WireError, match="version"):
+        deserialize_chain(stream)
+    assert WIRE_VERSION_STREAM == WIRE_VERSION + 1  # distinct on the wire
+
+
+def test_stream_wire_refuses_layout_and_length_mismatch():
+    buf = serialize_stream(_state(), *_stages(), SMETA)
+    with pytest.raises(WireError, match="layout"):
+        deserialize_stream(_retag(buf, layout="thld"))
+    # n_tokens != state length: a resumed slot would attend over
+    # positions that never arrived.
+    with pytest.raises(WireError, match="length"):
+        deserialize_stream(_retag(buf, n_tokens=9))
+    pl = serialize_stream(_state())
+    with pytest.raises(WireError, match="stray payload"):
+        deserialize_stream(pl + b"x")
+
+
+def test_stream_wire_refuses_crc_corruption():
+    buf = bytearray(serialize_stream(_state(), *_stages(), SMETA))
+    buf[-1] ^= 0x01  # one bit flip in the last v-page byte
+    with pytest.raises(WireError, match="CRC"):
+        deserialize_stream(bytes(buf))
+    # State tamper (seed swap) with the envelope intact: the CRC covers
+    # the canonical state bytes, so a doctored resume refuses too.
+    buf2 = serialize_stream(_state(), *_stages(), SMETA)
+    sd = _state().to_dict()
+    sd["seed"] = 99
+    with pytest.raises(WireError, match="CRC"):
+        deserialize_stream(_retag(buf2, stream=sd))
+
+
+def test_stream_wire_serializer_refusals():
+    st = _state()
+    pk, pv = _stages()
+    with pytest.raises(ValueError, match="both"):
+        serialize_stream(st, pk, None, SMETA)
+    with pytest.raises(ValueError, match="stream_page_meta"):
+        serialize_stream(st, pk, pv, None)
+    with pytest.raises(ValueError, match="length"):
+        serialize_stream(_state(length=0), pk, pv, SMETA)
+    with pytest.raises(ValueError, match="shapes differ"):
+        serialize_stream(st, pk, pv[:, :, :1], SMETA)
+    with pytest.raises(ValueError, match="disagree"):
+        serialize_stream(st, pk, pv, {**SMETA, "heads": 7})
+
+
+# -------------------------------------------------- fault plan + injector
+
+
+def test_fault_plan_generate_is_deterministic_and_capped():
+    a = FaultPlan.generate(7, 100, {"slow_decode_step": 3, "replica_kill": 1})
+    b = FaultPlan.generate(7, 100, {"slow_decode_step": 3, "replica_kill": 1})
+    assert a == b  # pure function of the arguments
+    assert a.events != FaultPlan.generate(
+        8, 100, {"slow_decode_step": 3, "replica_kill": 1}
+    ).events
+    assert all(1 <= e.step < 100 for e in a.events)
+    assert [e.step for e in a.events] == sorted(e.step for e in a.events)
+    # Counts auto-cap at the step span: every step of [2, 5) at most.
+    c = FaultPlan.generate(0, 5, {"slow_decode_step": 99}, min_step=2)
+    assert len(c.events) == 3
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.generate(0, 10, {"meteor_strike": 1})
+    with pytest.raises(ValueError, match="num_steps"):
+        FaultPlan.generate(0, 1, {"slow_decode_step": 1})
+
+
+def test_fault_plan_parse_spec_json_and_errors(tmp_path):
+    spec = "seed=7,slow_decode_step=3,replica_kill=1,slow_step_s=0.01"
+    plan = FaultPlan.parse(spec, num_steps=100)
+    assert plan == FaultPlan.generate(
+        7, 100, {"slow_decode_step": 3, "replica_kill": 1}, slow_step_s=0.01
+    )
+    with pytest.raises(ValueError, match="num_steps"):
+        FaultPlan.parse(spec)  # comma specs need a placement bound
+    with pytest.raises(ValueError, match="unknown --fault-plan key"):
+        FaultPlan.parse("seed=1,meteor_strike=1", num_steps=10)
+    with pytest.raises(ValueError, match="key=value"):
+        FaultPlan.parse("seed", num_steps=10)
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    assert FaultPlan.from_file(p) == plan  # explicit JSON round-trips
+
+
+def test_fault_injector_hooks_fire_once():
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+
+    plan = FaultPlan((
+        FaultEvent("probe_timeout", 1),
+        FaultEvent("wire_corrupt", 2),
+        FaultEvent("slow_decode_step", 3, duration_s=0.5),
+        FaultEvent("dispatch_error", 4),
+    ))
+    rec = FlightRecorder(64)
+    slept = []
+    inj = FaultInjector(plan, recorder=rec, sleep=slept.append)
+    assert inj.check_probe(1) and not inj.check_probe(1)  # one-shot
+    assert inj.check_wire(2) and not inj.check_wire(2)
+    assert not inj.check_wire(3)  # wrong kind at this step
+    inj.on_decode_step(3)
+    assert slept == [0.5]
+    inj.on_decode_step(3)
+    assert slept == [0.5]
+    with pytest.raises(InjectedFault, match="dispatch_error"):
+        inj.on_decode_step(4)
+    inj.on_decode_step(4)  # consumed: the retry sails through
+    assert inj.summary()["injected_faults"] == {
+        "probe_timeout": 1, "wire_corrupt": 1,
+        "slow_decode_step": 1, "dispatch_error": 1,
+    }
+    faults = [e["fault"] for e in rec.events() if e["kind"] == "fault_injected"]
+    assert sorted(faults) == ["dispatch_error", "probe_timeout",
+                              "slow_decode_step", "wire_corrupt"]
+
+
+def test_fault_injector_replica_kill_sigkills_the_process():
+    """``replica_kill`` is a REAL SIGKILL (no atexit, no cleanup): drive
+    it in a throwaway interpreter and expect the -9 exit."""
+    code = (
+        "from distributed_tensorflow_tpu.serve.faultinject import ("
+        "FaultEvent, FaultInjector, FaultPlan)\n"
+        "inj = FaultInjector(FaultPlan((FaultEvent('replica_kill', 2),)))\n"
+        "for s in range(5):\n"
+        "    inj.on_decode_step(s)\n"
+        "print('SURVIVED')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == -9, (out.returncode, out.stderr[-500:])
+    assert "SURVIVED" not in out.stdout
+
+
+# ------------------------------------------- real engines: export + adopt
+
+
+def _tiny_causal_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+
+    cfg = CausalLMConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, max_position=48,
+    )
+    model = CausalLM(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, cfg.max_position), jnp.int32),
+        jnp.ones((1, cfg.max_position), bool),
+    )
+    return model, variables["params"]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm(devices8):
+    return _tiny_causal_lm()
+
+
+def _mig_engine(tiny_lm, mesh=None, **kw):
+    from distributed_tensorflow_tpu.serve import CausalLMEngine
+
+    model, params = tiny_lm
+    # Composed on purpose: prefix cache + chunked prefill + speculation
+    # all live alongside the slot export/import cells — migration must
+    # not care which other serving features built the stream.
+    defaults = dict(
+        buckets=(8, 16), slots=3, max_batch=2, max_new_tokens=MAX_NEW,
+        prefix_cache_mb=0.25, block_tokens=4, prefill_chunk=8,
+        spec_tokens=2, stream_migrate=True,
+    )
+    defaults.update(kw)
+    return CausalLMEngine(model, params, mesh, **defaults)
+
+
+@pytest.fixture(scope="module")
+def mig_pair(tiny_lm):
+    """Victim client A and survivor client B (identical composed
+    engines), plus B's mounted stream receiver."""
+    from distributed_tensorflow_tpu.obs.flightrec import FlightRecorder
+    from distributed_tensorflow_tpu.serve import BatcherConfig, Client
+
+    cfg = dict(max_batch=2, max_queue=32, max_in_flight=2)
+    a = Client(_mig_engine(tiny_lm), BatcherConfig(**cfg),
+               recorder=FlightRecorder(2048))
+    b = Client(_mig_engine(tiny_lm), BatcherConfig(**cfg),
+               recorder=FlightRecorder(2048))
+    recv = make_stream_receiver(
+        b.batcher, b.engine, budget=TransferBudget(64 << 20),
+        metrics=b.metrics, recorder=b.recorder,
+    )
+    yield a, b, recv
+    a.close()
+    b.close()
+
+
+@pytest.fixture(scope="module")
+def mig_server(mig_pair):
+    """B's HTTP face with the stream receiver mounted: the loopback
+    rehearsal of a real survivor replica."""
+    from distributed_tensorflow_tpu.serve import build_http_server
+
+    _, b, recv = mig_pair
+    server = build_http_server(b, port=0, stream_receiver=recv,
+                               transfer_budget=recv.budget)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server.server_address
+    server.shutdown()
+    server.server_close()
+    thread.join(timeout=10)
+
+
+def _ref_greedy(model, params, prompt, n):
+    import jax.numpy as jnp
+
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        x = jnp.asarray([toks], jnp.int32)
+        logits = model.apply(
+            {"params": params}, x, jnp.ones((1, len(toks)), bool)
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+class _Pacer:
+    """Minimal decode-pace hook: sleeps EVERY step (unlike a seeded
+    FaultPlan it never runs out), so streams are reliably mid-generation
+    when a test exports them."""
+
+    def __init__(self, slow_s: float):
+        self.slow_s = slow_s
+
+    def on_decode_step(self, step: int) -> None:
+        time.sleep(self.slow_s)
+
+
+def _export_live(client, prompt, rid, *, want_pages=True, slow_s=0.15):
+    """Submit ``prompt`` and lift it back out mid-generation. Retries
+    with a fresh request id when the race loses (stream finished or still
+    prefilling); anything unsuitable re-adopts locally so no future
+    dangles. Returns the ExportedStream."""
+    for attempt in range(4):
+        r = rid if attempt == 0 else f"{rid}-retry{attempt}"
+        client.batcher.fault_injector = _Pacer(slow_s)
+        try:
+            fut = client.batcher.submit(
+                {"input_ids": [int(t) for t in prompt],
+                 "max_new_tokens": MAX_NEW},
+                request_id=r,
+            )
+            deadline = time.monotonic() + 20
+            while (time.monotonic() < deadline
+                   and client.batcher.status()["slots_active"] == 0):
+                time.sleep(0.01)
+            time.sleep(0.25)
+            exported = client.batcher.export_streams()
+        finally:
+            client.batcher.fault_injector = None
+        mine = [e for e in exported if e.state.request_id == r]
+        if not mine:
+            fut.result(timeout=60)  # finished before the export: go again
+            continue
+        exp = mine[0]
+        ok_pages = exp.pages_k is not None or not want_pages
+        if ok_pages and 0 < len(exp.state.tokens) < MAX_NEW:
+            return exp
+        # Wrong phase (still prefilling / already done): resume locally.
+        _chain_future(
+            client.batcher.adopt_stream(exp.state, exp.pages_k, exp.pages_v),
+            exp.future,
+        )
+        fut.result(timeout=60)
+    raise AssertionError(f"never caught {rid} mid-generation with pages")
+
+
+def test_paged_migration_parity_single_chip(mig_pair, tiny_lm):
+    a, b, recv = mig_pair
+    model, params = tiny_lm
+    rng = np.random.default_rng(41)
+    prompt = rng.integers(5, 64, size=10)
+    ref = _ref_greedy(model, params, prompt, MAX_NEW)
+    exp = _export_live(a, prompt, "par-paged-1")
+    buf = serialize_stream(exp.state, exp.pages_k, exp.pages_v,
+                           a.engine.stream_page_meta())
+    out = recv(buf)
+    assert out["adopted"] and out["pages"]
+    assert out["request_id"] == "par-paged-1"  # id survives the hop
+    assert out["resume_at"] == len(exp.state.tokens) > 0
+    res = recv.wait("par-paged-1", timeout_s=120)
+    # Bit-identical to the uninterrupted stream: the (seed, absolute
+    # position) sampling contract, with the shipped pages re-attended.
+    assert res["tokens"] == ref
+    # The id is single-use once collected (the 404 -> replay cue).
+    with pytest.raises(KeyError):
+        recv.wait("par-paged-1", timeout_s=1)
+    kinds = [e["kind"] for e in b.recorder.events()]
+    assert "stream_adopt" in kinds
+    assert b.metrics.stream_migrations.snapshot().get("adopted", 0) >= 1
+
+
+def test_page_less_migration_parity(mig_pair, tiny_lm):
+    a, b, recv = mig_pair
+    model, params = tiny_lm
+    rng = np.random.default_rng(43)
+    prompt = rng.integers(5, 64, size=9)
+    ref = _ref_greedy(model, params, prompt, MAX_NEW)
+    exp = _export_live(a, prompt, "par-pageless-1", want_pages=False)
+    out = recv(serialize_stream(exp.state))  # drop the pages on purpose
+    assert out["adopted"] and not out["pages"]
+    res = recv.wait("par-pageless-1", timeout_s=120)
+    assert res["tokens"] == ref  # re-prefill replay: same bits, just slower
+
+
+def test_paged_migration_parity_tp2(mig_pair, tiny_lm):
+    """1-chip victim -> tensor-parallel survivor: stream_page_meta talks
+    GLOBAL geometry, so the tp2 engine re-shards the shipped lane."""
+    from distributed_tensorflow_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        Client,
+        plan_serve_mesh,
+    )
+
+    a, _, _ = mig_pair
+    model, params = tiny_lm
+    spec, fell_back = plan_serve_mesh(tp=2, n_devices=8)
+    assert not fell_back
+    tp = Client(
+        _mig_engine(tiny_lm, build_mesh(spec),
+                    prefix_cache_mb=0.0, prefill_chunk=0, spec_tokens=0),
+        BatcherConfig(max_batch=2, max_queue=32, max_in_flight=2),
+    )
+    try:
+        recv_tp = make_stream_receiver(tp.batcher, tp.engine,
+                                       metrics=tp.metrics)
+        rng = np.random.default_rng(47)
+        prompt = rng.integers(5, 64, size=11)
+        ref = _ref_greedy(model, params, prompt, MAX_NEW)
+        exp = _export_live(a, prompt, "par-tp2-1")
+        out = recv_tp(serialize_stream(exp.state, exp.pages_k, exp.pages_v,
+                                       a.engine.stream_page_meta()))
+        assert out["pages"]
+        assert recv_tp.wait("par-tp2-1", timeout_s=120)["tokens"] == ref
+    finally:
+        tp.close()
+
+
+def test_migration_composes_with_prefix_cache_and_spec(mig_pair, tiny_lm):
+    """Two concurrent streams sharing a prompt head (prefix-cache food)
+    on spec-decoding engines, both lifted mid-flight: every token list
+    matches the reference, none lost, none doubled."""
+    a, b, recv = mig_pair
+    model, params = tiny_lm
+    rng = np.random.default_rng(53)
+    head = rng.integers(5, 64, size=8)
+    prompts = [np.concatenate([head, rng.integers(5, 64, size=3)])
+               for _ in range(2)]
+    refs = {f"comp-{i}": _ref_greedy(model, params, p, MAX_NEW)
+            for i, p in enumerate(prompts)}
+    a.batcher.fault_injector = _Pacer(0.2)
+    try:
+        futs = {
+            f"comp-{i}": a.batcher.submit(
+                {"input_ids": [int(t) for t in p],
+                 "max_new_tokens": MAX_NEW},
+                request_id=f"comp-{i}",
+            )
+            for i, p in enumerate(prompts)
+        }
+        deadline = time.monotonic() + 20
+        while (time.monotonic() < deadline
+               and a.batcher.status()["slots_active"] < 2):
+            time.sleep(0.01)
+        time.sleep(0.15)
+        exported = a.batcher.export_streams()
+    finally:
+        a.batcher.fault_injector = None
+    assert exported, "both streams finished before the export could run"
+    got = {}
+    for exp in exported:
+        rid = exp.state.request_id
+        if exp.pages_k is not None:
+            buf = serialize_stream(exp.state, exp.pages_k, exp.pages_v,
+                                   a.engine.stream_page_meta())
+        else:
+            buf = serialize_stream(exp.state)
+        recv(buf)
+        got[rid] = recv.wait(rid, timeout_s=120)["tokens"]
+    for rid, fut in futs.items():
+        if rid not in got:  # finished on A before the export: still parity
+            got[rid] = fut.result(timeout=60)["tokens"]
+    assert got == refs
+    assert len(got) == len(futs)  # exactly-once: no lost, no duplicated
+
+
+def test_receiver_refusals_fail_closed(mig_pair):
+    a, b, recv = mig_pair
+    before = b.metrics.stream_migrations.snapshot().get("rejected", 0)
+    meta = b.engine.stream_page_meta()
+
+    with pytest.raises(WireError):
+        recv(b"garbage bytes, not a stream")
+
+    # Geometry: SMETA's toy head_dim never matches the real engine's.
+    st = _state(n_prompt=8, n_gen=4, rid="ref-geo")
+    with pytest.raises(WireError, match="geometry"):
+        recv(serialize_stream(st, *_stages(), SMETA))
+
+    # A paged stream aimed at an engine with no slot-import cell.
+    no_import = make_stream_receiver(b.batcher, engine=None,
+                                     metrics=b.metrics, recorder=b.recorder)
+    gmeta = dict(meta)
+    rng = np.random.default_rng(1)
+    gshape = (meta["num_layers"], meta["cache_len"], meta["heads"],
+              meta["head_dim"])
+    gk = rng.standard_normal(gshape).astype(meta["dtype"])
+    gv = rng.standard_normal(gshape).astype(meta["dtype"])
+    good = serialize_stream(
+        _state(n_prompt=8, n_gen=4, rid="ref-noimp"), gk, gv, gmeta
+    )
+    with pytest.raises(WireError, match="stream_migrate"):
+        no_import(good)
+
+    # Budget shed: surfaces as Backpressure (429), not a refusal 400.
+    tight = make_stream_receiver(b.batcher, b.engine,
+                                 budget=TransferBudget(1, timeout_s=0.05),
+                                 metrics=b.metrics, recorder=b.recorder)
+    with pytest.raises(Backpressure):
+        tight(good)
+
+    # Settled-slot invariant: wire-consistent (n_tokens == length) but
+    # length != prompt + generated - 1 must refuse at adoption.
+    bad = _state(n_prompt=8, n_gen=4, rid="ref-inv", length=12)
+    with pytest.raises(WireError, match="refused"):
+        recv(serialize_stream(bad, gk, gv, gmeta))
+
+    # Capacity: prompt + max_new beyond this engine's cache pages.
+    big = _state(n_prompt=20, n_gen=3, rid="ref-cap", length=22,
+                 max_new_tokens=MAX_NEW)
+    with pytest.raises(WireError, match="refused"):
+        recv(serialize_stream(big, gk, gv, gmeta))
+
+    causes = {e.get("cause") for e in b.recorder.events()
+              if e["kind"] == "stream_migrate_reject"}
+    assert {"wire", "geometry", "no_import", "budget", "state"} <= causes
+    assert b.metrics.stream_migrations.snapshot()["rejected"] >= before + 6
+
+
+# --------------------------------------------------- HTTP routes + drains
+
+
+def _post_json(url, body=None, headers=None, timeout=30):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method="POST",
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_stream_migrate_http_route_and_wait(mig_pair, mig_server, tiny_lm):
+    a, b, recv = mig_pair
+    model, params = tiny_lm
+    base = "http://%s:%d" % mig_server
+
+    req = urllib.request.Request(
+        base + "/v1/stream_migrate", data=b"not a stream", method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        raise AssertionError("garbage must not adopt")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+    code, body = _post_json(base + "/v1/stream_wait", {})
+    assert code == 400 and "request_id" in body["error"]
+    code, body = _post_json(base + "/v1/stream_wait",
+                            {"request_id": "never-adopted"})
+    assert code == 404
+
+    rng = np.random.default_rng(59)
+    prompt = rng.integers(5, 64, size=10)
+    ref = _ref_greedy(model, params, prompt, MAX_NEW)
+    exp = _export_live(a, prompt, "http-mig-1")
+    buf = serialize_stream(exp.state, exp.pages_k, exp.pages_v,
+                           a.engine.stream_page_meta())
+    req = urllib.request.Request(base + "/v1/stream_migrate", data=buf,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["adopted"] and out["request_id"] == "http-mig-1"
+
+    with urllib.request.urlopen(base + "/statusz", timeout=10) as r:
+        status = json.loads(r.read())
+    assert "stream_migrate" in status  # pending registry is observable
+    assert status["kv_transfer"]["granted_total"] >= 1
+
+    code, body = _post_json(base + "/v1/stream_wait",
+                            {"request_id": "http-mig-1", "timeout_s": 120},
+                            timeout=150)
+    assert code == 200
+    assert body["request_id"] == "http-mig-1"  # correlation across the hop
+    assert body["tokens"] == ref
+
+
+class _StubEngine:
+    """Pure-python engine for the HTTP-only tests (no JAX)."""
+
+    max_batch = 4
+
+    def validate(self, payload):
+        from distributed_tensorflow_tpu.serve import RequestError
+
+        if "input_ids" not in payload:
+            raise RequestError("input_ids required")
+
+    def run_batch(self, payloads):
+        return [
+            {"pred_ids": np.asarray(p["input_ids"], np.int32),
+             "score": -1.5, "bucket": 16}
+            for p in payloads
+        ]
+
+
+def test_request_id_header_and_drainz_progress():
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        Client,
+        build_http_server,
+    )
+
+    client = Client(_StubEngine(), BatcherConfig(max_batch=4,
+                                                 max_delay_ms=2.0))
+    server = build_http_server(client, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = "http://%s:%d" % server.server_address
+    try:
+        code, body = _post_json(
+            base + "/v1/generate", {"input_ids": [1, 2, 3]},
+            headers={"X-Request-Id": "corr-42"},
+        )
+        assert code == 200
+        # The caller's id IS the id: failover and migration both key
+        # their follow-ups (stream_wait, replay) on it surviving.
+        assert body["request_id"] == "corr-42"
+
+        code, body = _post_json(base + "/drainz")
+        assert code == 200 and body["draining"] is True
+        for k in ("slots_active", "queued", "in_flight"):
+            assert k in body["progress"]
+        # Draining refuses new work at the door, attributably.
+        code, body = _post_json(base + "/v1/generate",
+                                {"input_ids": [4]},
+                                headers={"X-Request-Id": "corr-43"})
+        assert code == 503 and body["request_id"] == "corr-43"
+    finally:
+        server.shutdown()
+        server.server_close()
+        client.close()
+        thread.join(timeout=10)
+
+
+# ------------------------------------------------ chaos: degrade, don't lose
+
+
+def test_migrate_streams_chaos_wire_corrupt_zero_lost_dup(
+        mig_pair, mig_server, tiny_lm):
+    """Seeded wire corruption against the victim-side orchestrator: the
+    corrupted push refuses on CRC, the ladder degrades (page-less, then
+    re-adopt), and every stream still resolves exactly once with the
+    reference tokens."""
+    a, b, recv = mig_pair
+    model, params = tiny_lm
+    rng = np.random.default_rng(61)
+    prompts = [rng.integers(5, 64, size=int(n))
+               for n in rng.integers(8, 13, size=3)]
+    refs = {f"chaos-{i}": _ref_greedy(model, params, p, MAX_NEW)
+            for i, p in enumerate(prompts)}
+    a.batcher.fault_injector = _Pacer(0.2)
+    try:
+        futs = {
+            f"chaos-{i}": a.batcher.submit(
+                {"input_ids": [int(t) for t in p],
+                 "max_new_tokens": MAX_NEW},
+                request_id=f"chaos-{i}",
+            )
+            for i, p in enumerate(prompts)
+        }
+        deadline = time.monotonic() + 20
+        while (time.monotonic() < deadline
+               and a.batcher.status()["slots_active"] == 0):
+            time.sleep(0.01)
+        time.sleep(0.2)
+        # Corrupt the SECOND outbound buffer (n_sent restarts at 1 per
+        # migrate_streams call, so placement is deterministic): the first
+        # stream lands clean, the second's paged push refuses on CRC and
+        # falls back page-less to the same target.
+        fi = FaultInjector(FaultPlan((FaultEvent("wire_corrupt", 2),)))
+        digest = migrate_streams(
+            a.batcher, a.engine, [mig_server],
+            metrics=a.metrics, recorder=a.recorder, fault_injector=fi,
+        )
+    finally:
+        a.batcher.fault_injector = None
+    assert digest["exported"] >= 1, "all streams finished before migration"
+    assert digest["migrated"] + digest["readopted"] == digest["exported"]
+    assert digest["migrated"] >= 1  # buffer 1 was clean: at least one lands
+    if digest["exported"] >= 2:
+        assert len(fi.fired) >= 1  # the drill actually corrupted a payload
+    got = {}
+    for rid, fut in futs.items():
+        res = fut.result(timeout=120)
+        if res.get("status") == "migrated":
+            partial = res["tokens"]
+            final = recv.wait(rid, timeout_s=120)
+            # The victim's partial transcript is a PREFIX of the final
+            # one: the hop appended, never rewrote.
+            assert final["tokens"][:len(partial)] == partial
+            got[rid] = final["tokens"]
+        else:
+            got[rid] = res["tokens"]
+    assert got == refs  # zero lost, zero duplicated, bit-identical
+    kinds = [e["kind"] for e in a.recorder.events()]
+    assert "stream_export" in kinds
+    assert a.metrics.stream_migrations.snapshot().get("migrated", 0) >= 1
+
+
+def test_migrate_streams_readopts_when_no_survivor(mig_pair, tiny_lm):
+    """Every push target dead: migration degrades to a local re-adopt —
+    the drain takes longer, the stream still finishes HERE, correctly."""
+    import socket
+
+    a, _, _ = mig_pair
+    model, params = tiny_lm
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()  # nobody listens here any more
+    rng = np.random.default_rng(67)
+    prompt = rng.integers(5, 64, size=10)
+    ref = _ref_greedy(model, params, prompt, MAX_NEW)
+    a.batcher.fault_injector = _Pacer(0.2)
+    try:
+        fut = a.batcher.submit(
+            {"input_ids": [int(t) for t in prompt],
+             "max_new_tokens": MAX_NEW},
+            request_id="readopt-1",
+        )
+        deadline = time.monotonic() + 20
+        while (time.monotonic() < deadline
+               and a.batcher.status()["slots_active"] == 0):
+            time.sleep(0.01)
+        time.sleep(0.2)
+        digest = migrate_streams(
+            a.batcher, a.engine, [("127.0.0.1", dead_port)],
+            metrics=a.metrics, recorder=a.recorder, timeout_s=5.0,
+        )
+    finally:
+        a.batcher.fault_injector = None
+    res = fut.result(timeout=120)
+    if digest["exported"]:
+        assert digest["readopted"] == digest["exported"]
+        assert digest["migrated"] == 0
+        assert res.get("status") != "migrated"
+    assert res["tokens"] == ref
+    with pytest.raises(ValueError, match="target"):
+        migrate_streams(a.batcher, a.engine, [])
