@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick disagg-quick chaos-quick fleet-quick migrate-quick
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick disagg-quick chaos-quick fleet-quick migrate-quick quant-quick
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -75,6 +75,18 @@ fleet-quick:
 migrate-quick:
 	$(PY) -m pytest tests/test_migrate.py -q
 	$(PY) scripts/serve_bench.py --migrate --quick
+
+# Quantized-serving gate (~2 min): the int8 weight/KV unit + parity suite
+# (round-trip bounds, decode agreement on one chip and tp2, spec-on ==
+# spec-off under quant, prefix-cache cached-vs-cold, wire v3/v4 round-trip
+# + fp32<->int8 cross-refusal, /memz accounting), then the serve_bench
+# --quant A/B on a real tiny engine — teacher-forced top-1 agreement
+# >=0.99, logit MAE <=5% of mean |logit|, >=1.7x slots at the fp32 HBM
+# budget, and wire cross-refusal gate UNCONDITIONALLY even in --quick.
+# docs/DEPLOY.md "Quantized serving", docs/PERF.md r19.
+quant-quick:
+	$(PY) -m pytest tests/test_quant.py -q
+	$(PY) scripts/serve_bench.py --decode --quant --quick
 
 # Static analysis + config sweep over the package; nonzero exit on any
 # non-baselined finding or stale baseline entry.

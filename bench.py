@@ -101,13 +101,20 @@ def main():
     # resident device batch — measures end-to-end throughput WITH the feed
     # in the loop (vs the default device-only number). BENCH_PREFETCH sets
     # the lookahead depth (0 = synchronous feed, the r5-era behavior).
+    # BENCH_INPUT_DTYPE=bfloat16 narrows the assembled image batch at
+    # copy-out (data/loader.py out_dtype), halving host->device image
+    # bytes — the feed-side lever for the r19 input-path study.
     feed_mode = os.environ.get("BENCH_FEED", "")
+    input_dtype = os.environ.get("BENCH_INPUT_DTYPE", "float32")
     if feed_mode == "stream":
         from distributed_tensorflow_tpu.data import device_batches
         from distributed_tensorflow_tpu.data.prefetch import prefetch
 
         depth = int(os.environ.get("BENCH_PREFETCH", "2"))
-        stream = prefetch(device_batches(ds, mesh, global_batch, seed=0), depth)
+        stream = prefetch(
+            device_batches(ds, mesh, global_batch, seed=0, out_dtype=input_dtype),
+            depth,
+        )
     elif feed_mode:
         raise SystemExit(f"BENCH_FEED must be '' or 'stream', got {feed_mode!r}")
     else:
@@ -175,7 +182,7 @@ def main():
                 f"mfu={mfu:.3f}, median of {reps}x{n_long}-step windows, "
                 f"spread={spread:.1%}, "
                 + (
-                    f"feed=stream+prefetch{stream.depth}, "
+                    f"feed=stream+prefetch{stream.depth} in={input_dtype}, "
                     if stream is not None
                     else "feed=resident, "
                 )

@@ -23,7 +23,11 @@ Two halves:
   Decode cells that serve are further crossed with the prefix-cache,
   speculation, and disaggregated role-split plans (``DISAGG_VARIANTS`` →
   ``parallel/mesh.py::plan_disagg_mesh``), each under the same
-  plan-or-clean-ValueError contract.
+  plan-or-clean-ValueError contract. Every serving cell (BERT and
+  decode) is also crossed with ``QUANT_VARIANTS`` — the weight/KV
+  storage-dtype plans from ``_plan_quant`` — so an unsupported dtype or
+  an int8 × pipeline combination rejects at startup instead of dying
+  when the params quantize on metal.
 """
 
 from __future__ import annotations
@@ -80,6 +84,22 @@ SPEC_VARIANTS: tuple[tuple[int, int, int], ...] = (
     (4, 2, 32),
     (8, 3, 32),
     (32, 2, 32),   # spec_tokens == max_new_tokens: must reject
+)
+
+# Quantized-serving configurations crossed into EVERY serving cell (BERT
+# one-shot and causal-LM decode): (weight_dtype, kv_dtype). The
+# (None, None) row is the quant-off plan (must resolve to the config
+# dtype, never reject); int8 rows exercise the per-channel weight /
+# per-position KV storage plans across TP shardings; the fp8 row is an
+# unsupported dtype that must reject with a clean ValueError at plan
+# time. kv_dtype is ignored for BERT cells (no KV cache there).
+QUANT_VARIANTS: tuple[tuple[str | None, str | None], ...] = (
+    (None, None),
+    ("int8", "int8"),
+    ("int8", None),
+    (None, "int8"),
+    ("bfloat16", "bfloat16"),
+    ("fp8", None),   # unsupported: must reject with a clean ValueError
 )
 
 # Disaggregated-serving role splits crossed into every decode cell that
@@ -291,6 +311,51 @@ def run_config_sweep(
             try:
                 engine_cls._serve_config(cfg, tp=tp, ep=ep, pp=pp)
                 cell["outcome"] = "serves"
+                # Quantized-serving plan (engine _plan_quant): every
+                # weight/kv dtype combination on a serving cell must
+                # normalize to a storage plan or reject with a clean
+                # ValueError — a dtype that only dies when the params
+                # quantize or the cache allocates would be a raw XLA
+                # error on metal.
+                cell["quant"] = qplans = []
+                for wd, kd in QUANT_VARIANTS:
+                    qrow: dict = {"weight_dtype": wd, "kv_dtype": kd}
+                    try:
+                        if engine_cls is CausalLMEngine:
+                            w, k = engine_cls._plan_quant(
+                                cfg, tp=tp, weight_dtype=wd, kv_dtype=kd
+                            )
+                            qrow.update(weights=w, kv=k)
+                        else:
+                            w = engine_cls._plan_quant(
+                                cfg, tp=tp, ep=ep, pp=pp, weight_dtype=wd
+                            )
+                            qrow.update(weights=w)
+                    except ValueError as exc:
+                        qrow["rejects"] = str(exc)
+                    except Exception as exc:
+                        findings.append(
+                            Finding(
+                                check="SC002",
+                                path=(
+                                    "distributed_tensorflow_tpu/"
+                                    "serve/engine.py"
+                                ),
+                                line=0,
+                                scope=(
+                                    f"{engine_cls.__name__}._plan_quant"
+                                ),
+                                message=(
+                                    f"quant plan weight={wd} kv={kd} on "
+                                    f"preset '{name}' layout tp={tp} "
+                                    f"pp={pp} raised "
+                                    f"{type(exc).__name__} instead of a "
+                                    f"clean ValueError: {exc}"
+                                ),
+                            )
+                        )
+                        qrow["raised"] = type(exc).__name__
+                    qplans.append(qrow)
                 if engine_cls is CausalLMEngine:
                     # Cross the serving cell with the prefix-cache budget
                     # arithmetic (serve/kvpool.py + engine page pool): each

@@ -102,6 +102,7 @@ def restore_serving_state(
     template_state: Any,
     *,
     release_opt_state: bool = True,
+    weight_dtype: str | None = None,
     memory=None,
     recorder=None,
 ):
@@ -119,6 +120,14 @@ def restore_serving_state(
     for models too big for one chip. Returns ``(params, model_state,
     step)``. Raises ``FileNotFoundError`` when the directory holds no
     checkpoint: serving must never silently answer from random init.
+
+    ``weight_dtype`` quantizes (``"int8"``: per-channel absmax packing, see
+    models/quant.py) or casts (``"bfloat16"``) the restored params BEFORE
+    returning, and deletes every replaced fp32 kernel's device buffers —
+    checkpoints stay fp32 on disk, the conversion happens at the restore
+    boundary, and the reclaimed bytes extend the same released ledger the
+    opt-state release writes (component ``weight_quantization``). ``None``
+    keeps the checkpoint dtype.
 
     ``release_opt_state=True`` (the default) deletes the restored optimizer
     slots' and gradient ring's device buffers before returning — serving
@@ -152,9 +161,41 @@ def restore_serving_state(
             "released optimizer state after restore: %.1f MiB reclaimed",
             reclaimed / 2**20,
         )
+    params = state.params
+    quant_reclaimed = 0
+    wd = None
+    if weight_dtype is not None:
+        from distributed_tensorflow_tpu.models.quant import (
+            cast_params,
+            free_replaced_leaves,
+            normalize_quant_dtype,
+            quantize_params,
+        )
+
+        wd = normalize_quant_dtype(weight_dtype, "weight_dtype")
+        if wd == "int8":
+            new_params = quantize_params(params)
+        else:
+            import jax.numpy as jnp
+
+            new_params = cast_params(params, jnp.dtype(wd))
+        # Quantize-then-free: only REPLACED leaves die (embeddings, biases,
+        # LayerNorms are shared by identity and survive); the bytes land in
+        # the released ledger next to opt_state so /memz shows what the
+        # restore-time conversion bought.
+        quant_reclaimed = free_replaced_leaves(params, new_params)
+        params = new_params
+        if quant_reclaimed:
+            registry.register("weight_quantization", quant_reclaimed)
+            registry.release("weight_quantization")
+            logger.info(
+                "quantized restored params to %s: %.1f MiB of fp32 "
+                "kernels reclaimed", wd, quant_reclaimed / 2**20,
+            )
     if recorder is not None:
         recorder.record(
             "ckpt_restore", step=step, release_opt_state=release_opt_state,
-            reclaimed_bytes=reclaimed,
+            reclaimed_bytes=reclaimed, weight_dtype=wd,
+            quant_reclaimed_bytes=quant_reclaimed,
         )
-    return state.params, state.model_state, step
+    return params, state.model_state, step

@@ -59,6 +59,7 @@ def device_batches(
     out_size: tuple[int, int] | None = None,
     mean: np.ndarray | None = None,
     stddev: np.ndarray | None = None,
+    out_dtype: str = "float32",
 ) -> Iterator[dict]:
     """Infinite iterator of global batches sharded over the mesh's DP axes.
 
@@ -71,8 +72,14 @@ def device_batches(
 
     ``start_step`` starts the stream at batch N (resume). uint8 datasets are
     scaled to [0, 1] float; ``out_size`` center-crops (the numpy fallback for
-    the native pipeline's crop-resize path).
+    the native pipeline's crop-resize path). ``out_dtype="bfloat16"``
+    narrows the assembled image batch at copy-out (augmentation math stays
+    float32), halving the host→device image bytes — the numpy mirror of
+    the native pipeline's ``out_dtype``.
     """
+    from distributed_tensorflow_tpu.data.native import resolve_input_dtype
+
+    np_out = resolve_input_dtype(out_dtype)
     n = len(dataset)
     if global_batch > n:
         raise ValueError(f"global batch {global_batch} > dataset size {n}")
@@ -98,7 +105,9 @@ def device_batches(
         if mean is not None:
             images = (images - mean) / stddev
         local = {
-            "image": np.ascontiguousarray(images, np.float32),
+            "image": np.ascontiguousarray(images, np.float32).astype(
+                np_out, copy=False
+            ),
             "label": dataset.labels[idx],
         }
         yield {
@@ -123,6 +132,7 @@ def native_device_batches(
     seed: int = 0,
     start_step: int = 0,
     n_threads: int = 4,
+    out_dtype: str = "float32",
 ) -> Iterator[dict]:
     """Like :func:`device_batches` but fed by the native C++ pipeline.
 
@@ -156,6 +166,7 @@ def native_device_batches(
         stream_stride=global_batch,
         start_ticket=start_step,
         n_threads=n_threads,
+        out_dtype=out_dtype,
     )
     try:
         while True:

@@ -94,6 +94,26 @@ def native_available() -> bool:
     return _load() is not None
 
 
+def resolve_input_dtype(name) -> np.dtype:
+    """Normalize an input-batch dtype knob to a numpy dtype.
+
+    ``bfloat16`` resolves through ``ml_dtypes`` (numpy has no native
+    bf16); only float32 and bfloat16 are supported — images narrower
+    than bf16 lose augmentation precision for no transfer win the
+    roofline credits.
+    """
+    s = str(name).lower()
+    if s in ("bfloat16", "bf16"):
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    if s in ("float32", "f32", "fp32"):
+        return np.dtype(np.float32)
+    raise ValueError(
+        f"input dtype {name!r} not supported: pick float32 or bfloat16"
+    )
+
+
 class NativePipeline:
     """Threaded batch producer over an in-memory (or memory-mapped) dataset.
 
@@ -120,6 +140,13 @@ class NativePipeline:
     compose (C++ ring feeds the Python feeder thread). ``close()`` (or
     exiting the ``with`` block) unblocks any thread waiting in ``next()``,
     which then raises instead of returning garbage.
+
+    ``out_dtype="bfloat16"`` converts batches at the Python copy-out
+    (the C++ ring itself stays float32 — augmentation arithmetic keeps
+    full precision; only the staged result narrows). Halving the batch
+    bytes halves the host→device transfer the roofline charges to input
+    (docs/PERF.md r19) and matmul inputs arrive in the accelerator's
+    native compute dtype.
     """
 
     def __init__(
@@ -142,7 +169,9 @@ class NativePipeline:
         start_ticket: int = 0,
         n_threads: int = 4,
         queue_cap: int = 8,
+        out_dtype: str = "float32",
     ):
+        self._out_dtype = resolve_input_dtype(out_dtype)
         lib = _load()
         if lib is None:
             raise RuntimeError("native pipeline library unavailable")
@@ -195,6 +224,8 @@ class NativePipeline:
             # Racing close()/destruction: never hand back uninitialized
             # buffers as if they were data.
             raise RuntimeError("pipeline stopped while waiting for a batch")
+        if self._out_dtype != np.float32:
+            out_images = out_images.astype(self._out_dtype)
         return out_images, out_labels
 
     def __iter__(self):

@@ -778,6 +778,18 @@ def bert_param_specs(
         names = tuple(
             p.key for p in path if isinstance(p, jax.tree_util.DictKey)
         )
+        # Int8-packed kernels (models/quant.py): the "_q8" payload shards
+        # exactly like the fp32 kernel it replaced, and its per-output-
+        # channel "_q8_scale" vector carries only the kernel's LAST-axis
+        # sharding (replicated when the output axis is unsharded) — the
+        # quantize reduction keeps the trailing axis, so a shard-direct
+        # restore places both leaves without a resharding round-trip.
+        # Engines reject quantization for the stacked pipeline variant, so
+        # the encoder branch below never sees these suffixes.
+        quant = names[-1] if names and names[-1] in ("_q8", "_q8_scale") \
+            else None
+        if quant is not None:
+            names = names[:-1]
         # Stacked encoder (pipeline config): every leaf under "encoder"
         # carries a leading [num_layers] dim sharded over the pipeline axis.
         # TP/EP rules compose — the per-layer spec slots in behind the
@@ -790,10 +802,15 @@ def bert_param_specs(
                     inner = tuple(spec) + (None,) * (leaf.ndim - 1 - len(spec))
                     return P(pipeline_axis, *inner)
             return P(pipeline_axis, *(None,) * (leaf.ndim - 1))
+        matched = P()
         for suffix, spec in rules:
             if names[-len(suffix):] == suffix:
-                return spec
-        return P()
+                matched = spec
+                break
+        if quant == "_q8_scale":
+            last = tuple(matched)[-1] if len(tuple(matched)) else None
+            return P(last) if last is not None else P()
+        return matched
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
 

@@ -57,8 +57,28 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from distributed_tensorflow_tpu.models.bert import _tp_psum, bert_param_specs
+from distributed_tensorflow_tpu.models.quant import quantize_kv
 
 _MASK_VALUE = -1e30
+
+
+def _layer_cache(cache, i):
+    """Slice layer ``i`` out of a stacked cache — plain ``[nl, ...]`` array
+    or the quantized ``{"q", "s"}`` pytree (models/quant.py)."""
+    if isinstance(cache, dict):
+        return {"q": cache["q"][i], "s": cache["s"][i]}
+    return cache[i]
+
+
+def _stack_cache(layers):
+    """Re-stack per-layer cache returns, preserving the quantized pytree
+    structure when present."""
+    if isinstance(layers[0], dict):
+        return {
+            "q": jnp.stack([c["q"] for c in layers]),
+            "s": jnp.stack([c["s"] for c in layers]),
+        }
+    return jnp.stack(layers)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,27 +130,40 @@ def _causal_attention(q, k, v, pad_mask):
     ).astype(q.dtype)
 
 
-def _cached_attention(q, k_cache, v_cache, position):
+def _cached_attention(q, k_cache, v_cache, position, k_scale=None,
+                      v_scale=None):
     """One-token-per-slot attention against the slot cache.
 
     ``q: [S, h, d]``; caches ``[S, Lmax, h, d]``; ``position: [S]`` — the
     index the newest token was just written at (attends ``<= position``).
+    ``k_scale``/``v_scale`` (``[S, Lmax]``) carry the int8 cache's
+    per-position dequant factors: the k-scale multiplies the score matrix
+    after the QK^T product and the v-scale folds into the softmax weights
+    before the context product, so the dense cache is never materialized.
     """
     scale = q.shape[-1] ** -0.5
+    kc = k_cache if k_scale is None else k_cache.astype(jnp.float32)
     s = jnp.einsum(
-        "shd,slhd->shl", q, k_cache, preferred_element_type=jnp.float32
+        "shd,slhd->shl", q, kc, preferred_element_type=jnp.float32
     )
+    if k_scale is not None:
+        s = s * k_scale[:, None, :]
     s = s * scale
     valid = jnp.arange(k_cache.shape[1])[None, :] <= position[:, None]
     s = jnp.where(valid[:, None, :], s, _MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1) * valid[:, None, :]
+    vc = v_cache
+    if v_scale is not None:
+        p = p * v_scale[:, None, :]
+        vc = v_cache.astype(jnp.float32)
     return jnp.einsum(
-        "shl,slhd->shd", p.astype(v_cache.dtype), v_cache,
+        "shl,slhd->shd", p.astype(vc.dtype), vc,
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)
 
 
-def _chunk_attention(q, k_cache, v_cache, position):
+def _chunk_attention(q, k_cache, v_cache, position, k_scale=None,
+                     v_scale=None):
     """Chunk-of-queries attention against per-row caches.
 
     ``q: [B, C, h, d]``; caches ``[B, Lc, h, d]``; ``position: [B, C]`` —
@@ -141,11 +174,18 @@ def _chunk_attention(q, k_cache, v_cache, position):
     Cache positions beyond a row's written length hold zeros or a prior
     occupant's values — finite either way, and their softmax weight is
     exactly 0 under the causal mask, so they never reach the output.
+    ``k_scale``/``v_scale`` (``[B, Lc]``): the int8 cache's per-position
+    dequant factors, applied in the SAME factored order as
+    ``_cached_attention`` so verify columns stay bit-identical to the
+    decode steps they replace under quantization.
     """
     scale = q.shape[-1] ** -0.5
+    kc = k_cache if k_scale is None else k_cache.astype(jnp.float32)
     s = jnp.einsum(
-        "bchd,blhd->bhcl", q, k_cache, preferred_element_type=jnp.float32
+        "bchd,blhd->bhcl", q, kc, preferred_element_type=jnp.float32
     )
+    if k_scale is not None:
+        s = s * k_scale[:, None, None, :]
     s = s * scale
     valid = (
         jnp.arange(k_cache.shape[1])[None, None, :]
@@ -154,8 +194,12 @@ def _chunk_attention(q, k_cache, v_cache, position):
     m = valid[:, None, :, :]
     s = jnp.where(m, s, _MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1) * m
+    vc = v_cache
+    if v_scale is not None:
+        p = p * v_scale[:, None, None, :]
+        vc = v_cache.astype(jnp.float32)
     return jnp.einsum(
-        "bhcl,blhd->bchd", p.astype(v_cache.dtype), v_cache,
+        "bhcl,blhd->bchd", p.astype(vc.dtype), vc,
         preferred_element_type=jnp.float32,
     ).astype(q.dtype)
 
@@ -203,6 +247,25 @@ class CausalSelfAttention(nn.Module):
         # attention clamps — the lane's output is garbage nobody reads.
         q, k, v = self.query(x), self.key(x), self.value(x)  # [S, h, d]
         idx = jnp.arange(x.shape[0])
+        if isinstance(k_cache, dict):
+            # int8 KV mode: quantize the new token per slot at the write,
+            # attend with the factored per-position scales.
+            qk, sk = quantize_kv(k)
+            qv, sv = quantize_kv(v)
+            k_cache = {
+                "q": k_cache["q"].at[idx, position].set(qk, mode="drop"),
+                "s": k_cache["s"].at[idx, position].set(sk, mode="drop"),
+            }
+            v_cache = {
+                "q": v_cache["q"].at[idx, position].set(qv, mode="drop"),
+                "s": v_cache["s"].at[idx, position].set(sv, mode="drop"),
+            }
+            ctx = _cached_attention(
+                q, k_cache["q"], v_cache["q"],
+                jnp.minimum(position, k_cache["q"].shape[1] - 1),
+                k_scale=k_cache["s"], v_scale=v_cache["s"],
+            )
+            return self._finish(x, ctx), k_cache, v_cache
         k_cache = k_cache.at[idx, position].set(
             k.astype(k_cache.dtype), mode="drop"
         )
@@ -220,6 +283,27 @@ class CausalSelfAttention(nn.Module):
         # padding lanes -> the scatter drops); caches [B, Lc, h, d].
         q, k, v = self.query(x), self.key(x), self.value(x)  # [B, C, h, d]
         rows = jnp.arange(x.shape[0])[:, None]
+        if isinstance(k_cache, dict):
+            # int8 KV mode, chunk-wise: per-(row, position) scales written
+            # with the pages keep verify columns bit-identical to the
+            # decode steps they stand in for (same quantize-at-write, same
+            # factored dequant order).
+            qk, sk = quantize_kv(k)
+            qv, sv = quantize_kv(v)
+            k_cache = {
+                "q": k_cache["q"].at[rows, positions].set(qk, mode="drop"),
+                "s": k_cache["s"].at[rows, positions].set(sk, mode="drop"),
+            }
+            v_cache = {
+                "q": v_cache["q"].at[rows, positions].set(qv, mode="drop"),
+                "s": v_cache["s"].at[rows, positions].set(sv, mode="drop"),
+            }
+            ctx = _chunk_attention(
+                q, k_cache["q"], v_cache["q"],
+                jnp.minimum(positions, k_cache["q"].shape[1] - 1),
+                k_scale=k_cache["s"], v_scale=v_cache["s"],
+            )
+            return self._finish(x, ctx), k_cache, v_cache
         k_cache = k_cache.at[rows, positions].set(
             k.astype(k_cache.dtype), mode="drop"
         )
@@ -346,10 +430,13 @@ class CausalLM(nn.Module):
         )  # [S, H]
         new_k, new_v = [], []
         for i, layer in enumerate(self.layers):
-            x, kc, vc = layer.decode(x, k_cache[i], v_cache[i], position)
+            x, kc, vc = layer.decode(
+                x, _layer_cache(k_cache, i), _layer_cache(v_cache, i),
+                position,
+            )
             new_k.append(kc)
             new_v.append(vc)
-        return self._head(x), jnp.stack(new_k), jnp.stack(new_v)
+        return self._head(x), _stack_cache(new_k), _stack_cache(new_v)
 
     def prefill_chunk(self, input_ids, positions, k_cache, v_cache):
         # Absolute-position chunk prefill against the slot cache: caches
@@ -359,16 +446,17 @@ class CausalLM(nn.Module):
         # anything attends it — the same dead-store argument decode_step
         # relies on for slot reuse. Positions are clamped for embedding /
         # attention; raw (possibly sentinel) positions drive the writes.
-        Lc = k_cache.shape[2]
+        Lc = (k_cache["q"] if isinstance(k_cache, dict) else k_cache).shape[2]
         x = self._embed(input_ids, jnp.minimum(positions, Lc - 1))
         new_k, new_v = [], []
         for i, layer in enumerate(self.layers):
             x, kc, vc = layer.prefill_chunk(
-                x, positions, k_cache[i], v_cache[i]
+                x, positions, _layer_cache(k_cache, i),
+                _layer_cache(v_cache, i)
             )
             new_k.append(kc)
             new_v.append(vc)
-        return self._head(x), jnp.stack(new_k), jnp.stack(new_v)
+        return self._head(x), _stack_cache(new_k), _stack_cache(new_v)
 
     def verify_step(self, tokens, positions, k_cache, v_cache):
         # Speculative-decoding verify over the slot table: [S, K+1] tokens

@@ -126,6 +126,17 @@ def _split_layout_labels(snapshot: dict, value_key: str) -> list[tuple[dict, flo
     return out
 
 
+def _kv_bytes_per_token_family(m) -> Family:
+    """Per-dtype slot-cache bytes per cached token (models/quant.py int8
+    KV halves-and-then-some this; the label keeps fp32 and int8 engines
+    distinguishable on one dashboard)."""
+    fam = Family("serve_kv_bytes_per_token", "gauge",
+                 "slot-cache bytes one cached token occupies, by KV dtype")
+    for dtype, v in m.kv_bytes_per_token.snapshot().items():
+        fam.add(v, {"dtype": dtype})
+    return fam
+
+
 def serve_families(
     metrics, slo=None, health=None, memory=None, grid=None
 ) -> list[Family]:
@@ -171,6 +182,7 @@ def serve_families(
         Family("serve_kv_pool_bytes", "gauge",
                "KV bytes held by the prefix-cache block pool")
         .add(m.kv_pool_bytes.value),
+        _kv_bytes_per_token_family(m),
         # Speculative-decoding families (serve/spec.py).
         Family("serve_spec_draft_tokens_total", "counter",
                "speculative draft tokens proposed")
@@ -357,16 +369,29 @@ def serve_families(
 
     if memory is not None:
         snap = memory.snapshot()
+        dtypes = snap.get("component_dtypes", {})
         hbm = Family("hbm_reserved_bytes", "gauge",
                      "accounted device-memory reservation per component")
         for comp, nbytes in snap["components"].items():
-            hbm.add(nbytes, {"component": comp})
+            lbl = {"component": comp}
+            # Quantized serving: components that declared a storage dtype
+            # carry it, so "how much of HBM is int8" is one PromQL sum.
+            if comp in dtypes:
+                lbl["dtype"] = dtypes[comp]
+            hbm.add(nbytes, lbl)
         fams.append(hbm)
         released = Family("hbm_released_bytes_total", "counter",
                           "device bytes released per component since boot")
         for comp, nbytes in snap["released"].items():
             released.add(nbytes, {"component": comp})
         fams.append(released)
+        saved = Family(
+            "hbm_bytes_saved_vs_fp32", "gauge",
+            "bytes saved vs an fp32 baseline per quantized component",
+        )
+        for comp, nbytes in snap.get("bytes_saved_vs_fp32", {}).items():
+            saved.add(nbytes, {"component": comp})
+        fams.append(saved)
         in_use = Family("hbm_device_bytes_in_use", "gauge",
                         "backend-reported bytes_in_use per local device")
         limit = Family("hbm_device_bytes_limit", "gauge",

@@ -61,20 +61,42 @@ class MemoryRegistry:
 
     # Shared mutable state; every access is ordered by self._lock (the
     # sanitize_races soak can watch these when a test wraps an instance).
-    _RACETRACE_ATTRS = ("_reserved", "_released")
+    _RACETRACE_ATTRS = ("_reserved", "_released", "_dtypes", "_fp32")
 
     def __init__(self, devices_fn=None):
         self._lock = threading.Lock()
         self._reserved: dict[str, int] = {}
         self._released: dict[str, int] = {}
+        # Quantized-serving ledger (PR 19): the storage dtype a component
+        # declared and what its payload WOULD cost at fp32, so /memz can
+        # answer "what did int8 buy me" per component without re-deriving
+        # shapes.
+        self._dtypes: dict[str, str] = {}
+        self._fp32: dict[str, int] = {}
         self._devices_fn = devices_fn
 
     # -------------------------------------------------------- bookkeeping
 
-    def register(self, component: str, nbytes: int) -> None:
-        """Set ``component``'s reservation to ``nbytes`` (absolute)."""
+    def register(self, component: str, nbytes: int, *,
+                 dtype: str | None = None,
+                 fp32_nbytes: int | None = None) -> None:
+        """Set ``component``'s reservation to ``nbytes`` (absolute).
+
+        ``dtype`` / ``fp32_nbytes`` are optional quantization metadata:
+        the storage dtype and the fp32-equivalent byte count of the same
+        payload (``/memz`` reports ``fp32_nbytes - nbytes`` as
+        ``bytes_saved_vs_fp32``)."""
         with self._lock:
-            self._reserved[str(component)] = int(nbytes)
+            key = str(component)
+            self._reserved[key] = int(nbytes)
+            if dtype is not None:
+                self._dtypes[key] = str(dtype)
+            else:
+                self._dtypes.pop(key, None)
+            if fp32_nbytes is not None:
+                self._fp32[key] = int(fp32_nbytes)
+            else:
+                self._fp32.pop(key, None)
 
     def add(self, component: str, nbytes: int) -> None:
         """Grow ``component``'s reservation by ``nbytes``."""
@@ -82,11 +104,13 @@ class MemoryRegistry:
             key = str(component)
             self._reserved[key] = self._reserved.get(key, 0) + int(nbytes)
 
-    def register_tree(self, component: str, tree) -> int:
+    def register_tree(self, component: str, tree, *,
+                      dtype: str | None = None,
+                      fp32_nbytes: int | None = None) -> int:
         """``register`` with bytes summed from an array pytree; returns the
         byte count so callers can log it."""
         n = tree_nbytes(tree)
-        self.register(component, n)
+        self.register(component, n, dtype=dtype, fp32_nbytes=fp32_nbytes)
         return n
 
     def release(self, component: str, nbytes: int | None = None) -> int:
@@ -184,13 +208,23 @@ class MemoryRegistry:
 
     def snapshot(self) -> dict:
         """The ``GET /memz`` body: per-component reservations, the freed
-        ledger, per-device stats, and the reconciliation digest."""
+        ledger, quantization metadata (storage dtype + bytes saved vs an
+        fp32 baseline, per component and total), per-device stats, and the
+        reconciliation digest."""
         with self._lock:
             reserved = dict(sorted(self._reserved.items()))
             released = dict(sorted(self._released.items()))
+            dtypes = dict(sorted(self._dtypes.items()))
+            saved = {
+                key: self._fp32[key] - self._reserved.get(key, 0)
+                for key in sorted(self._fp32)
+            }
         return {
             "components": reserved,
             "released": released,
+            "component_dtypes": dtypes,
+            "bytes_saved_vs_fp32": saved,
+            "bytes_saved_vs_fp32_total": sum(saved.values()),
             "devices": self.device_stats(),
             **self.reconcile(),
         }

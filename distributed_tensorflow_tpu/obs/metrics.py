@@ -166,6 +166,27 @@ class Histogram:
         }
 
 
+class LabelledGauge:
+    """Thread-safe gauge family keyed by label (per-dtype KV bytes per
+    token). Labels are created on first ``set``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals: dict = {}
+
+    def set(self, label, v: float) -> None:
+        with self._lock:
+            self._vals[label] = float(v)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {str(k): v for k, v in sorted(self._vals.items())}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._vals.clear()
+
+
 class LabelledCounter:
     """Thread-safe counter family keyed by label (per-tier / per-bucket
     hit counts). Labels are created on first ``inc``."""
@@ -357,6 +378,11 @@ class ServeMetrics:
         self.prefix_hits = Counter()
         self.prefix_tokens_saved = Counter()
         self.kv_pool_bytes = Gauge()
+        # Quantized serving (models/quant.py): slot-cache bytes one cached
+        # token occupies, keyed by the engine's KV storage dtype — the
+        # capacity story behind int8 KV ("serve_kv_bytes_per_token" in
+        # prom; DEPLOY.md's sizing math divides the HBM budget by this).
+        self.kv_bytes_per_token = LabelledGauge()
         # Speculative-decoding (serve/spec.py) families: drafted candidate
         # tokens, the subset the verify step accepted, and verify steps
         # that rejected at least one draft. acceptance = accepted/drafted;
@@ -472,6 +498,7 @@ class ServeMetrics:
             "prefix_hits": self.prefix_hits.value,
             "prefix_tokens_saved": self.prefix_tokens_saved.value,
             "kv_pool_bytes": self.kv_pool_bytes.value,
+            "kv_bytes_per_token": self.kv_bytes_per_token.snapshot(),
             "draft_tokens": self.draft_tokens.value,
             "accepted_tokens": self.accepted_tokens.value,
             "spec_rejects": self.spec_rejects.value,

@@ -803,6 +803,14 @@ class ContinuousBatcher:
         # Draft-length cache-headroom guard; engines without a fixed
         # cache_len (stubs) are unconstrained.
         self._cache_len = getattr(engine, "cache_len", 1 << 30)
+        # Quantized-serving capacity gauge: engines that know their KV
+        # storage dtype publish bytes/token once at attach (static for the
+        # engine's lifetime; the dtype label keeps mixed fleets legible).
+        if callable(getattr(engine, "kv_bytes_per_token", None)):
+            self.metrics.kv_bytes_per_token.set(
+                getattr(engine, "kv_dtype", "float32"),
+                engine.kv_bytes_per_token(),
+            )
         # tokens_per_step numerator/denominator for status(): emitted
         # tokens over decode+verify step completions — the speculation
         # win at a glance. Spec accounting totals live here too.

@@ -81,6 +81,19 @@ carried positions to its own ``cache_len`` (refusing streams longer than
 its cache) and resumes decoding mid-generation via
 ``ContinuousBatcher.adopt_stream`` (tests/test_migrate.py pins each
 refusal and the bit-parity contract).
+
+Quantized engines (``kv_dtype="int8"``) speak the same two formats one
+version up: **chain version 3** and **stream version 4** carry each page
+side as its int8 ``q`` payload immediately followed by that side's
+per-position float32 scales (``qk · sk · qv · sv``), and the CRC covers
+the scale bytes too — a flipped scale byte refuses exactly like a
+flipped page byte. Version and header dtype must agree (v1/v2 ⇒ dtype ≠
+int8, v3/v4 ⇒ dtype == int8) or the parser refuses the buffer as
+internally inconsistent. Cross-dtype adoption fails closed in BOTH
+directions: an fp32 receiver refuses an int8 buffer (and vice versa) on
+the ``page_meta`` dtype comparison, and peers predating these versions
+refuse v3/v4 on the version number alone (tests/test_quant.py pins the
+round-trips and both refusal directions).
 """
 
 from __future__ import annotations
@@ -101,6 +114,8 @@ __all__ = [
     "WireError",
     "WIRE_VERSION",
     "WIRE_VERSION_STREAM",
+    "WIRE_VERSION_QUANT",
+    "WIRE_VERSION_STREAM_QUANT",
     "serialize_chain",
     "deserialize_chain",
     "serialize_stream",
@@ -120,6 +135,8 @@ logger = logging.getLogger(__name__)
 WIRE_MAGIC = b"KVPG"
 WIRE_VERSION = 1
 WIRE_VERSION_STREAM = 2
+WIRE_VERSION_QUANT = 3  # int8 chain: q pages + f32 per-position scales
+WIRE_VERSION_STREAM_QUANT = 4  # int8 stream: same payload rule as v3
 _PREFIX = struct.Struct(">4sHI")  # magic, version, header_len
 _LAYOUT = "lbthd"
 _STREAM_LAYOUT = "lthd"
@@ -142,9 +159,39 @@ def serialize_chain(token_ids, pages_k, pages_v, page_meta: dict) -> bytes:
     ``page_meta`` is the source engine's :meth:`page_meta` digest. The
     token ids ride in the header so the receiving pool can index the
     chain under its own trie without a side channel.
+
+    Quantized pools pass each side as its ``{"q", "s"}`` tree (int8
+    pages + float32 ``[num_layers, n, block_tokens]`` scales); the
+    buffer then travels as version :data:`WIRE_VERSION_QUANT` with the
+    scales appended to their side's payload and covered by the CRC.
     """
-    pk = np.ascontiguousarray(pages_k)
-    pv = np.ascontiguousarray(pages_v)
+    if isinstance(pages_k, dict) != isinstance(pages_v, dict):
+        raise ValueError(
+            "k/v pages must both be plain arrays or both {'q','s'} trees"
+        )
+    quantized = isinstance(pages_k, dict)
+    if quantized:
+        pk = np.ascontiguousarray(pages_k["q"])
+        pv = np.ascontiguousarray(pages_v["q"])
+        sk = np.ascontiguousarray(np.asarray(pages_k["s"], dtype=np.float32))
+        sv = np.ascontiguousarray(np.asarray(pages_v["s"], dtype=np.float32))
+        if pk.dtype != np.int8:
+            raise ValueError(
+                f"quantized pages must be int8, got {pk.dtype.name}"
+            )
+        if sk.shape != pk.shape[:3] or sv.shape != pv.shape[:3]:
+            raise ValueError(
+                f"scale shapes {sk.shape}/{sv.shape} do not cover "
+                f"[l,b,t] of pages {pk.shape}"
+            )
+    else:
+        pk = np.ascontiguousarray(pages_k)
+        pv = np.ascontiguousarray(pages_v)
+        if pk.dtype == np.int8:
+            raise ValueError(
+                "int8 pages need their {'q','s'} scale tree — a bare "
+                "int8 array cannot be dequantized on the far side"
+            )
     if pk.shape != pv.shape:
         raise ValueError(f"k/v page shapes differ: {pk.shape} vs {pv.shape}")
     if pk.ndim != 5:
@@ -154,7 +201,12 @@ def serialize_chain(token_ids, pages_k, pages_v, page_meta: dict) -> bytes:
             f"{len(token_ids)} token keys do not cover exactly the "
             f"{pk.shape[1]} pages carried (block_tokens={pk.shape[2]})"
         )
-    payload = pk.tobytes() + pv.tobytes()
+    if quantized:
+        payload = pk.tobytes() + sk.tobytes() + pv.tobytes() + sv.tobytes()
+        version = WIRE_VERSION_QUANT
+    else:
+        payload = pk.tobytes() + pv.tobytes()
+        version = WIRE_VERSION
     header = {
         "page_meta": {
             "num_layers": int(pk.shape[0]),
@@ -175,13 +227,14 @@ def serialize_chain(token_ids, pages_k, pages_v, page_meta: dict) -> bytes:
             f"pages {got} disagree with the engine's page_meta {expect}"
         )
     hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    return _PREFIX.pack(WIRE_MAGIC, WIRE_VERSION, len(hbytes)) + hbytes + payload
+    return _PREFIX.pack(WIRE_MAGIC, version, len(hbytes)) + hbytes + payload
 
 
 def deserialize_chain(buf: bytes):
     """Parse + verify a wire buffer: returns ``(token_ids, pages_k,
-    pages_v, header)`` with host-numpy page stages. Every malformation
-    raises :class:`WireError` BEFORE any page bytes are trusted."""
+    pages_v, header)`` with host-numpy page stages (``{"q", "s"}`` trees
+    for a quantized v3 buffer). Every malformation raises
+    :class:`WireError` BEFORE any page bytes are trusted."""
     if len(buf) < _PREFIX.size:
         raise WireError(
             f"buffer of {len(buf)} bytes is shorter than the "
@@ -190,10 +243,11 @@ def deserialize_chain(buf: bytes):
     magic, version, hlen = _PREFIX.unpack_from(buf)
     if magic != WIRE_MAGIC:
         raise WireError(f"bad magic {magic!r} (expected {WIRE_MAGIC!r})")
-    if version != WIRE_VERSION:
+    if version not in (WIRE_VERSION, WIRE_VERSION_QUANT):
         raise WireError(
-            f"wire version {version} unsupported (speaker of version "
-            f"{WIRE_VERSION}); refusing rather than guessing the layout"
+            f"wire version {version} unsupported (speaker of chain "
+            f"versions {WIRE_VERSION} and {WIRE_VERSION_QUANT}); "
+            "refusing rather than guessing the layout"
         )
     if len(buf) < _PREFIX.size + hlen:
         raise WireError(
@@ -228,7 +282,20 @@ def deserialize_chain(buf: bytes):
             f"but the buffer carries {shape[1]} pages — a receiving pool "
             "would index blocks whose pages never arrived"
         )
-    nbytes = int(np.prod(shape)) * dtype.itemsize
+    quantized = version == WIRE_VERSION_QUANT
+    if quantized != (dtype == np.dtype(np.int8)):
+        raise WireError(
+            f"wire version {version} carrying {dtype.name} pages is "
+            f"internally inconsistent — int8 travels as version "
+            f"{WIRE_VERSION_QUANT} with scale payloads, everything else "
+            f"as version {WIRE_VERSION}"
+        )
+    if quantized:
+        qbytes = int(np.prod(shape))
+        sbytes = int(np.prod(shape[:3])) * 4
+        nbytes = qbytes + sbytes
+    else:
+        nbytes = int(np.prod(shape)) * dtype.itemsize
     payload = buf[_PREFIX.size + hlen:]
     if len(payload) != 2 * nbytes:
         raise WireError(
@@ -237,8 +304,21 @@ def deserialize_chain(buf: bytes):
         )
     if zlib.crc32(payload) & 0xFFFFFFFF != crc:
         raise WireError("payload CRC mismatch: pages corrupted in flight")
-    pages_k = np.frombuffer(payload[:nbytes], dtype).reshape(shape)
-    pages_v = np.frombuffer(payload[nbytes:], dtype).reshape(shape)
+    if quantized:
+        def side(off):
+            return {
+                "q": np.frombuffer(
+                    payload[off:off + qbytes], np.int8
+                ).reshape(shape),
+                "s": np.frombuffer(
+                    payload[off + qbytes:off + nbytes], np.float32
+                ).reshape(shape[:3]),
+            }
+
+        pages_k, pages_v = side(0), side(nbytes)
+    else:
+        pages_k = np.frombuffer(payload[:nbytes], dtype).reshape(shape)
+        pages_v = np.frombuffer(payload[nbytes:], dtype).reshape(shape)
     return token_ids, pages_k, pages_v, header
 
 
@@ -266,11 +346,17 @@ def serialize_stream(state, pages_k=None, pages_v=None,
     ``None`` ships a page-less stream (``n_tokens=0``): the receiver
     re-prefills from the state's tokens, which is bit-identical by the
     (seed, absolute position) sampling contract, just slower.
+
+    Quantized slot caches pass each stage as its ``{"q", "s"}`` tree
+    (int8 positions + float32 ``[num_layers, T]`` scales); the buffer
+    then travels as version :data:`WIRE_VERSION_STREAM_QUANT` with the
+    scales in the CRC-covered payload.
     """
     sd = state.to_dict() if hasattr(state, "to_dict") else dict(state)
     sbytes = _canonical_state(sd)
     if (pages_k is None) != (pages_v is None):
         raise ValueError("pages_k and pages_v must both be given or both None")
+    version = WIRE_VERSION_STREAM
     if pages_k is None:
         n, meta, payload = 0, {}, b""
     else:
@@ -284,10 +370,42 @@ def serialize_stream(state, pages_k=None, pages_v=None,
             raise ValueError(
                 f"a page-carrying stream needs state length >= 1, got {n}"
             )
+        if isinstance(pages_k, dict) != isinstance(pages_v, dict):
+            raise ValueError(
+                "k/v stages must both be plain arrays or both "
+                "{'q','s'} trees"
+            )
+        quantized = isinstance(pages_k, dict)
         # device_get is fine here: stream serialization runs off the
         # decode loop (export already copied the slot out of the cache).
-        pk = np.ascontiguousarray(np.asarray(pages_k)[:, :n])
-        pv = np.ascontiguousarray(np.asarray(pages_v)[:, :n])
+        if quantized:
+            pk = np.ascontiguousarray(np.asarray(pages_k["q"])[:, :n])
+            pv = np.ascontiguousarray(np.asarray(pages_v["q"])[:, :n])
+            sk = np.ascontiguousarray(
+                np.asarray(pages_k["s"], dtype=np.float32)[:, :n]
+            )
+            sv = np.ascontiguousarray(
+                np.asarray(pages_v["s"], dtype=np.float32)[:, :n]
+            )
+            if pk.dtype != np.int8:
+                raise ValueError(
+                    f"quantized stages must be int8, got {pk.dtype.name}"
+                )
+            if sk.shape != pk.shape[:2] or sv.shape != pv.shape[:2]:
+                raise ValueError(
+                    f"scale shapes {sk.shape}/{sv.shape} do not cover "
+                    f"[l,t] of stages {pk.shape}"
+                )
+            version = WIRE_VERSION_STREAM_QUANT
+        else:
+            pk = np.ascontiguousarray(np.asarray(pages_k)[:, :n])
+            pv = np.ascontiguousarray(np.asarray(pages_v)[:, :n])
+            if pk.dtype == np.int8:
+                raise ValueError(
+                    "int8 stages need their {'q','s'} scale tree — a "
+                    "bare int8 array cannot be dequantized on the far "
+                    "side"
+                )
         if pk.shape != pv.shape:
             raise ValueError(f"k/v stage shapes differ: {pk.shape} vs {pv.shape}")
         if pk.ndim != 4:
@@ -304,7 +422,10 @@ def serialize_stream(state, pages_k=None, pages_v=None,
                 f"pages {meta} disagree with the engine's "
                 f"stream_page_meta {dict(page_meta)}"
             )
-        payload = pk.tobytes() + pv.tobytes()
+        if quantized:
+            payload = pk.tobytes() + sk.tobytes() + pv.tobytes() + sv.tobytes()
+        else:
+            payload = pk.tobytes() + pv.tobytes()
     header = {
         "stream": sd,
         "page_meta": meta,
@@ -314,16 +435,17 @@ def serialize_stream(state, pages_k=None, pages_v=None,
     }
     hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
     return (
-        _PREFIX.pack(WIRE_MAGIC, WIRE_VERSION_STREAM, len(hbytes))
+        _PREFIX.pack(WIRE_MAGIC, version, len(hbytes))
         + hbytes + payload
     )
 
 
 def deserialize_stream(buf: bytes):
     """Parse + verify a stream wire buffer: returns ``(state_dict,
-    pages_k, pages_v, header)`` — pages ``None`` for a page-less stream.
-    Every malformation raises :class:`WireError` BEFORE any byte of
-    state or pages is trusted (fail-closed: refuse, never guess)."""
+    pages_k, pages_v, header)`` — pages ``None`` for a page-less stream,
+    ``{"q", "s"}`` trees for a quantized v4 buffer. Every malformation
+    raises :class:`WireError` BEFORE any byte of state or pages is
+    trusted (fail-closed: refuse, never guess)."""
     if len(buf) < _PREFIX.size:
         raise WireError(
             f"buffer of {len(buf)} bytes is shorter than the "
@@ -332,10 +454,11 @@ def deserialize_stream(buf: bytes):
     magic, version, hlen = _PREFIX.unpack_from(buf)
     if magic != WIRE_MAGIC:
         raise WireError(f"bad magic {magic!r} (expected {WIRE_MAGIC!r})")
-    if version != WIRE_VERSION_STREAM:
+    if version not in (WIRE_VERSION_STREAM, WIRE_VERSION_STREAM_QUANT):
         raise WireError(
             f"stream wire version {version} unsupported (speaker of "
-            f"version {WIRE_VERSION_STREAM}); refusing rather than "
+            f"stream versions {WIRE_VERSION_STREAM} and "
+            f"{WIRE_VERSION_STREAM_QUANT}); refusing rather than "
             "guessing the layout"
         )
     if len(buf) < _PREFIX.size + hlen:
@@ -362,14 +485,21 @@ def deserialize_stream(buf: bytes):
             f"stream page layout {layout!r} unsupported "
             f"(expected {_STREAM_LAYOUT!r})"
         )
+    quantized = version == WIRE_VERSION_STREAM_QUANT
     payload = buf[_PREFIX.size + hlen:]
     if n == 0:
+        if quantized:
+            raise WireError(
+                "a quantized stream buffer (v4) must carry pages — "
+                "page-less streams travel as version "
+                f"{WIRE_VERSION_STREAM}"
+            )
         if payload:
             raise WireError(
                 f"page-less stream carries {len(payload)} stray payload bytes"
             )
         pk = pv = None
-        shape = dtype = nbytes = None
+        shape = dtype = nbytes = qbytes = None
     else:
         if n != length:
             raise WireError(
@@ -386,7 +516,19 @@ def deserialize_stream(buf: bytes):
             dtype = np.dtype(meta["dtype"])
         except (KeyError, TypeError, ValueError) as e:
             raise WireError(f"header missing/invalid field: {e}") from e
-        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if quantized != (dtype == np.dtype(np.int8)):
+            raise WireError(
+                f"stream wire version {version} carrying {dtype.name} "
+                f"pages is internally inconsistent — int8 travels as "
+                f"version {WIRE_VERSION_STREAM_QUANT} with scale "
+                f"payloads, everything else as version "
+                f"{WIRE_VERSION_STREAM}"
+            )
+        if quantized:
+            qbytes = int(np.prod(shape))
+            nbytes = qbytes + int(np.prod(shape[:2])) * 4
+        else:
+            nbytes = int(np.prod(shape)) * dtype.itemsize
         if len(payload) != 2 * nbytes:
             raise WireError(
                 f"payload of {len(payload)} bytes != 2 x {nbytes} "
@@ -396,7 +538,19 @@ def deserialize_stream(buf: bytes):
         raise WireError(
             "stream CRC mismatch: state or pages corrupted in flight"
         )
-    if n:
+    if n and quantized:
+        def side(off):
+            return {
+                "q": np.frombuffer(
+                    payload[off:off + qbytes], np.int8
+                ).reshape(shape),
+                "s": np.frombuffer(
+                    payload[off + qbytes:off + nbytes], np.float32
+                ).reshape(shape[:2]),
+            }
+
+        pk, pv = side(0), side(nbytes)
+    elif n:
         pk = np.frombuffer(payload[:nbytes], dtype).reshape(shape)
         pv = np.frombuffer(payload[nbytes:], dtype).reshape(shape)
     return sd, pk, pv, header
@@ -620,10 +774,11 @@ class DisaggServingPair:
             import jax
 
             n = len(blocks)
+            hk, hv = jax.device_get((pk, pv))
             buf = serialize_chain(
                 token_ids,
-                np.asarray(jax.device_get(pk))[:, :n],
-                np.asarray(jax.device_get(pv))[:, :n],
+                _slice_chain(hk, n),
+                _slice_chain(hv, n),
                 engine.page_meta(),
             )
             ids, wk, wv, _ = deserialize_chain(buf)
@@ -668,9 +823,21 @@ class DisaggServingPair:
         self.decode.close(drain=drain)
 
 
-def _pad_chain(pages: np.ndarray, max_chain: int) -> np.ndarray:
-    """Pad a ``[l, n, t, h, d]`` chain stage to the import cell's fixed
-    ``max_chain`` lanes (pad lanes are dropped by sentinel ids)."""
+def _slice_chain(pages, n: int):
+    """First ``n`` chain lanes of a host page stage (plain array or
+    quantized ``{"q", "s"}`` tree — every leaf shares axis 1)."""
+    if isinstance(pages, dict):
+        return {k: np.asarray(v)[:, :n] for k, v in pages.items()}
+    return np.asarray(pages)[:, :n]
+
+
+def _pad_chain(pages, max_chain: int):
+    """Pad a ``[l, n, t, h, d]`` chain stage (or each leaf of a
+    quantized ``{"q", "s"}`` tree — scales share the chain axis) to the
+    import cell's fixed ``max_chain`` lanes (pad lanes are dropped by
+    sentinel ids)."""
+    if isinstance(pages, dict):
+        return {k: _pad_chain(v, max_chain) for k, v in pages.items()}
     n = pages.shape[1]
     if n > max_chain:
         raise WireError(
@@ -722,9 +889,10 @@ def make_kv_receiver(batcher, engine, *, budget: TransferBudget | None = None,
                 recorder.record("kv_transfer_reject", "", cause="budget",
                                 bytes=nbytes)
                 raise
+        n_blocks = int(header["n_blocks"])
         try:
             t0 = time.monotonic()
-            recorder.record("kv_transfer_start", "", blocks=pk.shape[1],
+            recorder.record("kv_transfer_start", "", blocks=n_blocks,
                             bytes=nbytes, transport="wire")
             adopted = batcher.adopt_chain(
                 token_ids,
@@ -738,7 +906,7 @@ def make_kv_receiver(batcher, engine, *, budget: TransferBudget | None = None,
         if metrics is not None:
             metrics.kv_transfer_bytes.inc("decode", nbytes)
             metrics.kv_transfer_seconds.observe("decode", dt)
-        recorder.record("kv_transfer_done", "", blocks=pk.shape[1],
+        recorder.record("kv_transfer_done", "", blocks=n_blocks,
                         adopted=adopted, bytes=nbytes,
                         ms=round(dt * 1e3, 3))
         return {"adopted_blocks": adopted, "bytes": nbytes}
@@ -784,10 +952,14 @@ def post_kv_transfer(host: str, port: int, buf: bytes, *,
 # ------------------------------------------------- cross-process migration
 
 
-def _pad_stream_stage(stage: np.ndarray, cache_len: int) -> np.ndarray:
-    """Pad a ``[l, n, h, d]`` stream stage to the receiver's full
-    ``cache_len`` positions (the slot-import cell scatters whole slots;
-    pad positions sit beyond ``length`` and are never attended)."""
+def _pad_stream_stage(stage, cache_len: int):
+    """Pad a ``[l, n, h, d]`` stream stage (or each leaf of a quantized
+    ``{"q", "s"}`` tree — scales share the position axis) to the
+    receiver's full ``cache_len`` positions (the slot-import cell
+    scatters whole slots; pad positions sit beyond ``length`` and are
+    never attended)."""
+    if isinstance(stage, dict):
+        return {k: _pad_stream_stage(v, cache_len) for k, v in stage.items()}
     n = stage.shape[1]
     if n > cache_len:
         raise WireError(

@@ -54,6 +54,15 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu.models.causal_lm import sample_tokens
+from distributed_tensorflow_tpu.models.quant import (
+    cast_params,
+    dequantize_params,
+    fp32_equiv_nbytes,
+    is_quantized_tree,
+    normalize_quant_dtype,
+    quantize_kv,
+    quantize_params,
+)
 from distributed_tensorflow_tpu.obs.memory import default_registry, tree_nbytes
 from distributed_tensorflow_tpu.parallel.mesh import (
     batch_sharding,
@@ -346,6 +355,9 @@ def _make_bert_forward(model, return_logits: bool):
 
     def forward(params, input_ids, attention_mask, token_type_ids,
                 mlm_targets):
+        # Int8 weight mode: unpack {"_q8","_q8_scale"} kernels in-graph —
+        # HBM holds int8; XLA fuses the convert into each matmul read.
+        params = dequantize_params(params, model.cfg.dtype)
         mlm_logits, nsp_logits, pooled = model.apply(
             {"params": params},
             input_ids,
@@ -424,6 +436,7 @@ class BertInferenceEngine(_AotEngine):
         max_batch: int = 8,
         batch_tiers: tuple[int, ...] | None = None,
         return_logits: bool = False,
+        weight_dtype: str | None = None,
         memory=None,
     ):
         super().__init__(mesh, max_batch, batch_tiers, memory=memory)
@@ -436,6 +449,15 @@ class BertInferenceEngine(_AotEngine):
             type(model)(serve_cfg) if serve_cfg is not model.cfg else model
         )
         cfg = self.model.cfg
+        self.weight_dtype = self._plan_quant(
+            cfg, tp=tp, ep=ep, pp=pp, weight_dtype=weight_dtype
+        )
+        if is_quantized_tree(params):
+            self.weight_dtype = "int8"
+        elif self.weight_dtype == "int8":
+            params = quantize_params(params)
+        elif jnp.dtype(self.weight_dtype) != jnp.dtype(cfg.dtype):
+            params = cast_params(params, jnp.dtype(self.weight_dtype))
         self.buckets = tuple(
             sorted({min(int(b), cfg.max_position) for b in buckets})
         )
@@ -463,7 +485,10 @@ class BertInferenceEngine(_AotEngine):
         else:
             self._param_specs = None
         self.params = self._place(params)
-        self.memory.register_tree("bert_params", self.params)
+        self.memory.register_tree(
+            "bert_params", self.params, dtype=self.weight_dtype,
+            fp32_nbytes=fp32_equiv_nbytes(self.params),
+        )
         # AOT-compile one executable per (batch tier, sequence bucket) NOW:
         # startup pays every trace/compile, the request path pays none (jit
         # cache lookups included — these are Compiled objects, not jit
@@ -493,6 +518,27 @@ class BertInferenceEngine(_AotEngine):
             "BERT engine ready: layout=%s buckets=%s tiers=%s (%d executables)",
             self.layout, self.buckets, self.batch_tiers, len(self._compiled),
         )
+
+    @staticmethod
+    def _plan_quant(cfg, *, tp: int = 1, ep: int = 1, pp: int = 1,
+                    weight_dtype: str | None = None) -> str:
+        """Validate the weight-quantization knob for this config/layout and
+        return the concrete dtype name (``None`` resolves to the model's
+        compute dtype). Raises ``ValueError`` loudly at startup, the SC002
+        clean-rejection contract. int8 x pipeline rejects: the stacked
+        ``[pp, ...]`` pipeline kernels would fold the stage axis into the
+        per-channel absmax reduction, silently sharing scales across
+        stages. MoE expert stacks simply stay fp32 (quantize_params skips
+        non-"kernel" leaf names), so ep needs no constraint."""
+        del tp, ep
+        w = normalize_quant_dtype(weight_dtype, "weight_dtype")
+        if w == "int8" and pp > 1:
+            raise ValueError(
+                f"weight_dtype=int8 does not support the stacked "
+                f"pipeline-parallel variant (pipeline axis of {pp}): "
+                "per-channel scales would span pipeline stages"
+            )
+        return w or str(np.dtype(cfg.dtype).name)
 
     @staticmethod
     def _serve_config(cfg, tp: int, ep: int, pp: int):
@@ -676,6 +722,14 @@ class BertInferenceEngine(_AotEngine):
         return results
 
 
+def _kv_leaf(cache):
+    """The payload leaf of a KV operand: the int8 ``"q"`` array of a
+    quantized ``{"q", "s"}`` pytree, or the plain dense array. Geometry
+    (layers/slots/cache_len/heads/head_dim) is always read off this leaf so
+    shape logic is mode-agnostic."""
+    return cache["q"] if isinstance(cache, dict) else cache
+
+
 def _make_causal_prefill(model):
     """Prefill executable body for one (tier, bucket): run the full causal
     forward, scatter every layer's K/V into the slot cache pages, and
@@ -683,22 +737,37 @@ def _make_causal_prefill(model):
 
     Tier padding rows carry slot index == S (one past the pool) so the
     ``mode="drop"`` scatters write nowhere — padding can never dirty a
-    live slot's pages."""
+    live slot's pages.
+
+    Quantized caches (``{"q", "s"}`` pytrees) quantize the fresh fp32 K/V
+    at the scatter — same per-position absmax the incremental decode write
+    uses, so a prefilled page is bit-identical to one the decode path
+    would have written."""
 
     def prefill_fn(params, ck, cv, last, ids, mask, slots, lengths, temps,
                    seeds):
+        params = dequantize_params(params, model.cfg.dtype)
         logits, k, v = model.apply(
             {"params": params}, ids, mask, method="prefill"
         )
         rows = jnp.arange(ids.shape[0])
         last_logits = logits[rows, jnp.maximum(lengths, 1) - 1]
         tok = sample_tokens(last_logits, temps, seeds, lengths)
-        ck = ck.at[:, slots, : ids.shape[1]].set(
-            k.astype(ck.dtype), mode="drop"
-        )
-        cv = cv.at[:, slots, : ids.shape[1]].set(
-            v.astype(cv.dtype), mode="drop"
-        )
+        L = ids.shape[1]
+
+        def scatter(cache, fresh):
+            if isinstance(cache, dict):
+                q, s = quantize_kv(fresh)  # [nl, T, L, h, d] -> s [nl, T, L]
+                return {
+                    "q": cache["q"].at[:, slots, :L].set(q, mode="drop"),
+                    "s": cache["s"].at[:, slots, :L].set(s, mode="drop"),
+                }
+            return cache.at[:, slots, :L].set(
+                fresh.astype(cache.dtype), mode="drop"
+            )
+
+        ck = scatter(ck, k)
+        cv = scatter(cv, v)
         last = last.at[slots].set(tok, mode="drop")
         return ck, cv, last, tok
 
@@ -715,6 +784,7 @@ def _make_causal_decode(model, cache_len: int):
     chunks already filled (chunked prefill never re-writes them)."""
 
     def decode_fn(params, ck, cv, last, lengths, active, temps, seeds):
+        params = dequantize_params(params, model.cfg.dtype)
         pos = jnp.where(
             active, jnp.minimum(lengths, cache_len - 1), cache_len
         )
@@ -750,6 +820,7 @@ def _make_causal_verify(model, cache_len: int, k: int):
 
     def verify_fn(params, ck, cv, last, drafts, lengths, n_input, temps,
                   seeds):
+        params = dequantize_params(params, model.cfg.dtype)
         tokens = jnp.concatenate([last[:, None], drafts], axis=1)  # [S, k+1]
         cols = jnp.arange(k + 1)[None, :]
         pos = lengths[:, None] + cols
@@ -799,32 +870,43 @@ def _make_causal_chunk_prefill(model, cache_len: int):
 
     def chunk_fn(params, ck, cv, last, pool_k, pool_v, ids, starts,
                  lengths, chain, n_gather, slots, temps, seeds):
-        nl = ck.shape[0]
+        params = dequantize_params(params, model.cfg.dtype)
+        nl = _kv_leaf(ck).shape[0]
         T, C = ids.shape
-        rows_k = ck[:, slots]  # [nl, T, Lc, h, d]; padding slot ix clamps
-        rows_v = cv[:, slots]
-        bt = pool_k.shape[2]
+        # Quantized caches are {"q","s"} pytrees: every gather/blend/scatter
+        # below maps over both leaves, so prefix pages move WITH their
+        # scales bit-exactly (the cached-vs-cold parity contract).
+        rows_k = jax.tree.map(lambda a: a[:, slots], ck)  # padding ix clamps
+        rows_v = jax.tree.map(lambda a: a[:, slots], cv)
+        bt = _kv_leaf(pool_k).shape[2]
         M = chain.shape[1]
         span = M * bt
-        gk = pool_k[:, chain].reshape(nl, T, span, *pool_k.shape[3:])
-        gv = pool_v[:, chain].reshape(nl, T, span, *pool_v.shape[3:])
-        sel = (
-            jnp.arange(span)[None, :] < (n_gather * bt)[:, None]
-        )[None, :, :, None, None]
-        rows_k = rows_k.at[:, :, :span].set(
-            jnp.where(sel, gk, rows_k[:, :, :span])
-        )
-        rows_v = rows_v.at[:, :, :span].set(
-            jnp.where(sel, gv, rows_v[:, :, :span])
-        )
+        sel_rows = jnp.arange(span)[None, :] < (n_gather * bt)[:, None]
+
+        def blend(rows, pool):
+            def one(r, p):
+                g = p[:, chain].reshape(nl, T, span, *p.shape[3:])
+                sel = sel_rows.reshape((1, T, span) + (1,) * (p.ndim - 3))
+                return r.at[:, :, :span].set(
+                    jnp.where(sel, g, r[:, :, :span])
+                )
+
+            return jax.tree.map(one, rows, pool)
+
+        rows_k = blend(rows_k, pool_k)
+        rows_v = blend(rows_v, pool_v)
         pos = starts[:, None] + jnp.arange(C)[None, :]
         wpos = jnp.where(pos < lengths[:, None], pos, cache_len)
         logits, nk, nv = model.apply(
             {"params": params}, ids, wpos, rows_k, rows_v,
             method="prefill_chunk",
         )
-        ck = ck.at[:, slots].set(nk, mode="drop")
-        cv = cv.at[:, slots].set(nv, mode="drop")
+        ck = jax.tree.map(
+            lambda c, n: c.at[:, slots].set(n, mode="drop"), ck, nk
+        )
+        cv = jax.tree.map(
+            lambda c, n: c.at[:, slots].set(n, mode="drop"), cv, nv
+        )
         is_last = starts + C >= lengths
         li = jnp.clip(lengths - 1 - starts, 0, C - 1)
         tok = sample_tokens(
@@ -849,18 +931,22 @@ def _make_prefix_insert(block_tokens: int):
     still-referenced operand."""
 
     def insert_fn(pool_k, pool_v, ck, cv, slot, block_ids, block_pos):
-        nl, _, lc = ck.shape[:3]
+        nl, _, lc = _kv_leaf(ck).shape[:3]
         nb = lc // block_tokens
-        src_k = ck[:, slot, : nb * block_tokens].reshape(
-            nl, nb, block_tokens, *ck.shape[3:]
-        )
-        src_v = cv[:, slot, : nb * block_tokens].reshape(
-            nl, nb, block_tokens, *cv.shape[3:]
-        )
         bp = jnp.minimum(block_pos, nb - 1)
-        pool_k = pool_k.at[:, block_ids].set(src_k[:, bp], mode="drop")
-        pool_v = pool_v.at[:, block_ids].set(src_v[:, bp], mode="drop")
-        return pool_k, pool_v, ck, cv
+
+        def publish(pool, cache):
+            def one(p, c):
+                # Works for both ranks: c.shape[3:] is (h, d) for pages and
+                # () for the per-position scale plane.
+                src = c[:, slot, : nb * block_tokens].reshape(
+                    nl, nb, block_tokens, *c.shape[3:]
+                )
+                return p.at[:, block_ids].set(src[:, bp], mode="drop")
+
+            return jax.tree.map(one, pool, cache)
+
+        return publish(pool_k, ck), publish(pool_v, cv), ck, cv
 
     return insert_fn
 
@@ -875,10 +961,10 @@ def _make_pool_export():
     immutable for the duration."""
 
     def export_fn(pool_k, pool_v, block_ids):
-        return (
-            jnp.take(pool_k, block_ids, axis=1),
-            jnp.take(pool_v, block_ids, axis=1),
+        take = lambda p: jax.tree.map(  # noqa: E731
+            lambda a: jnp.take(a, block_ids, axis=1), p
         )
+        return take(pool_k), take(pool_v)
 
     return export_fn
 
@@ -894,9 +980,10 @@ def _make_pool_import():
     untouched."""
 
     def import_fn(pool_k, pool_v, pages_k, pages_v, block_ids):
-        pool_k = pool_k.at[:, block_ids].set(pages_k, mode="drop")
-        pool_v = pool_v.at[:, block_ids].set(pages_v, mode="drop")
-        return pool_k, pool_v
+        put = lambda p, g: jax.tree.map(  # noqa: E731
+            lambda a, b: a.at[:, block_ids].set(b, mode="drop"), p, g
+        )
+        return put(pool_k, pages_k), put(pool_v, pages_v)
 
     return import_fn
 
@@ -910,7 +997,10 @@ def _make_slot_export():
     pool-block granularity)."""
 
     def export_fn(ck, cv, slot):
-        return (jnp.take(ck, slot, axis=1), jnp.take(cv, slot, axis=1))
+        take = lambda c: jax.tree.map(  # noqa: E731
+            lambda a: jnp.take(a, slot, axis=1), c
+        )
+        return take(ck), take(cv)
 
     return export_fn
 
@@ -924,8 +1014,11 @@ def _make_slot_import():
     chain; dispatches between decode steps on the loop thread."""
 
     def import_fn(ck, cv, last, stage_k, stage_v, slot, tok):
-        ck = ck.at[:, slot].set(stage_k)
-        cv = cv.at[:, slot].set(stage_v)
+        put = lambda c, st: jax.tree.map(  # noqa: E731
+            lambda a, b: a.at[:, slot].set(b), c, st
+        )
+        ck = put(ck, stage_k)
+        cv = put(cv, stage_v)
         last = last.at[slot].set(tok)
         return ck, cv, last
 
@@ -1011,6 +1104,8 @@ class CausalLMEngine(_AotEngine):
         spec_backoff: float = 0.25,
         kv_transfer: bool = False,
         stream_migrate: bool = False,
+        weight_dtype: str | None = None,
+        kv_dtype: str | None = None,
         memory=None,
     ):
         if slots < 1:
@@ -1026,6 +1121,24 @@ class CausalLMEngine(_AotEngine):
             type(model)(serve_cfg) if serve_cfg is not model.cfg else model
         )
         cfg = self.model.cfg
+        # Quantized serving (ROADMAP item 4; docs/DEPLOY.md "Quantized
+        # serving"): weight_dtype packs kernels to int8 at engine build
+        # (idempotent — restore_serving_state may have packed them already),
+        # kv_dtype stores cache/pool pages as int8 {"q","s"} pytrees.
+        self.weight_dtype, self.kv_dtype = self._plan_quant(
+            cfg, tp=tp, weight_dtype=weight_dtype, kv_dtype=kv_dtype
+        )
+        if is_quantized_tree(params):
+            self.weight_dtype = "int8"
+        elif self.weight_dtype == "int8":
+            params = quantize_params(params)
+        elif jnp.dtype(self.weight_dtype) != jnp.dtype(cfg.dtype):
+            params = cast_params(params, jnp.dtype(self.weight_dtype))
+        self._kv_quantized = self.kv_dtype == "int8"
+        self._kv_store_dtype = (
+            jnp.dtype(cfg.dtype) if self._kv_quantized
+            else jnp.dtype(self.kv_dtype)
+        )
         self.slots = slots
         self.buckets = tuple(
             sorted({min(int(b), cfg.max_position) for b in buckets})
@@ -1073,27 +1186,26 @@ class CausalLMEngine(_AotEngine):
         else:
             self._param_specs = None
             self._cache_spec = P()
-        self._cache_sharding = NamedSharding(self.mesh, self._cache_spec)
+        self._cache_sharding = self._kv_sharding(self._cache_spec)
         self._rep = replicated_sharding(self.mesh)
         self.params = self._place(params)
-        self._cache_k = jax.device_put(
-            jnp.zeros(cache_shape, cfg.dtype), self._cache_sharding
-        )
-        self._cache_v = jax.device_put(
-            jnp.zeros(cache_shape, cfg.dtype), self._cache_sharding
-        )
+        self._cache_k = self._kv_zeros(cache_shape, self._cache_sharding)
+        self._cache_v = self._kv_zeros(cache_shape, self._cache_sharding)
         self._last_token = jax.device_put(
             jnp.zeros((slots,), jnp.int32), self._rep
         )
-        self.memory.register_tree("lm_params", self.params)
+        self.memory.register_tree(
+            "lm_params", self.params, dtype=self.weight_dtype,
+            fp32_nbytes=fp32_equiv_nbytes(self.params),
+        )
+        kv_bytes = tree_nbytes(self._cache_k) + tree_nbytes(self._cache_v)
         self.memory.register(
-            "kv_slot_cache", self._cache_k.nbytes + self._cache_v.nbytes
+            "kv_slot_cache", kv_bytes, dtype=self.kv_dtype,
+            fp32_nbytes=2 * int(np.prod(cache_shape)) * 4,
         )
         # Per-slot share of the slot-table KV cache: the batcher multiplies
         # this by slots_active so /statusz and /memz agree on active bytes.
-        self.slot_page_bytes = (
-            self._cache_k.nbytes + self._cache_v.nbytes
-        ) // slots
+        self.slot_page_bytes = kv_bytes // slots
 
         # Prefix-cache / chunked-prefill plumbing. Legacy mode (both knobs
         # 0) compiles the original monolithic prefill grid; chunked mode
@@ -1115,11 +1227,12 @@ class CausalLMEngine(_AotEngine):
             self._max_chain = max(1, self.buckets[-1] // self.block_tokens)
             n_blocks, self._bytes_per_block = self._plan_prefix_cache(
                 cfg, tp=tp, prefix_cache_mb=prefix_cache_mb,
-                block_tokens=self.block_tokens,
+                block_tokens=self.block_tokens, kv_dtype=self.kv_dtype,
             )
             if prefix_cache_mb > 0:
                 self.prefix_cache = KVBlockPool(
-                    n_blocks, self.block_tokens, self._bytes_per_block
+                    n_blocks, self.block_tokens, self._bytes_per_block,
+                    dtype=self.kv_dtype,
                 )
             else:
                 n_blocks = 1  # dummy pool keeps one chunk operand layout
@@ -1128,14 +1241,13 @@ class CausalLMEngine(_AotEngine):
                 cfg.num_heads, cfg.hidden_size // cfg.num_heads,
             )
             self._pool_blocks = n_blocks
-            self._pool_k = jax.device_put(
-                jnp.zeros(pool_shape, cfg.dtype), self._cache_sharding
-            )
-            self._pool_v = jax.device_put(
-                jnp.zeros(pool_shape, cfg.dtype), self._cache_sharding
-            )
+            self._pool_k = self._kv_zeros(pool_shape, self._cache_sharding)
+            self._pool_v = self._kv_zeros(pool_shape, self._cache_sharding)
             self.memory.register(
-                "kv_prefix_pool", self._pool_k.nbytes + self._pool_v.nbytes
+                "kv_prefix_pool",
+                tree_nbytes(self._pool_k) + tree_nbytes(self._pool_v),
+                dtype=self.kv_dtype,
+                fp32_nbytes=2 * int(np.prod(pool_shape)) * 4,
             )
         else:
             self.prefill_chunk_size = 0
@@ -1171,8 +1283,8 @@ class CausalLMEngine(_AotEngine):
                             jax.jit(fn, donate_argnums=(1, 2, 3))
                             .lower(
                                 self.params,
-                                self._cache_struct(cache_shape, cfg.dtype),
-                                self._cache_struct(cache_shape, cfg.dtype),
+                                self._kv_struct(cache_shape),
+                                self._kv_struct(cache_shape),
                                 self._rep_struct((slots,), jnp.int32),
                                 self._rep_struct((T, L), jnp.int32),
                                 self._rep_struct((T, L), jnp.bool_),
@@ -1197,7 +1309,7 @@ class CausalLMEngine(_AotEngine):
             chunk_fn = self._wrap_chunk(
                 _make_causal_chunk_prefill(self.model, self.cache_len)
             )
-            pool_struct = self._cache_struct(pool_shape, cfg.dtype)
+            pool_struct = self._kv_struct(pool_shape)
             for T in self.batch_tiers:
                 for C in self._chunk_buckets:
                     self._chunk_compiled[T, C] = self._compile_cell(
@@ -1206,8 +1318,8 @@ class CausalLMEngine(_AotEngine):
                             jax.jit(chunk_fn, donate_argnums=(1, 2, 3))
                             .lower(
                                 self.params,
-                                self._cache_struct(cache_shape, cfg.dtype),
-                                self._cache_struct(cache_shape, cfg.dtype),
+                                self._kv_struct(cache_shape),
+                                self._kv_struct(cache_shape),
                                 self._rep_struct((slots,), jnp.int32),
                                 pool_struct,
                                 pool_struct,
@@ -1235,8 +1347,8 @@ class CausalLMEngine(_AotEngine):
                         .lower(
                             pool_struct,
                             pool_struct,
-                            self._cache_struct(cache_shape, cfg.dtype),
-                            self._cache_struct(cache_shape, cfg.dtype),
+                            self._kv_struct(cache_shape),
+                            self._kv_struct(cache_shape),
                             self._rep_struct((), jnp.int32),
                             self._rep_struct((self._max_chain,), jnp.int32),
                             self._rep_struct((self._max_chain,), jnp.int32),
@@ -1245,10 +1357,9 @@ class CausalLMEngine(_AotEngine):
                     ),
                 )
             if self._kv_transfer:
-                pages_struct = self._cache_struct(
+                pages_struct = self._kv_struct(
                     (cfg.num_layers, self._max_chain, self.block_tokens,
                      *pool_shape[3:]),
-                    cfg.dtype,
                 )
                 # Export gathers pinned pages OUT of the pool — the pool
                 # operands are NOT donated (they must survive the gather;
@@ -1291,8 +1402,8 @@ class CausalLMEngine(_AotEngine):
                 jax.jit(decode_fn, donate_argnums=(1, 2, 3))
                 .lower(
                     self.params,
-                    self._cache_struct(cache_shape, cfg.dtype),
-                    self._cache_struct(cache_shape, cfg.dtype),
+                    self._kv_struct(cache_shape),
+                    self._kv_struct(cache_shape),
                     self._rep_struct((slots,), jnp.int32),
                     self._rep_struct((slots,), jnp.int32),
                     self._rep_struct((slots,), jnp.bool_),
@@ -1316,8 +1427,8 @@ class CausalLMEngine(_AotEngine):
                     jax.jit(verify_fn, donate_argnums=(1, 2, 3))
                     .lower(
                         self.params,
-                        self._cache_struct(cache_shape, cfg.dtype),
-                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._kv_struct(cache_shape),
+                        self._kv_struct(cache_shape),
                         self._rep_struct((slots,), jnp.int32),
                         self._rep_struct(
                             (slots, self.spec_tokens), jnp.int32
@@ -1334,11 +1445,12 @@ class CausalLMEngine(_AotEngine):
             stage_spec = (
                 P(None, None, "model", None) if self._model_sharded else P()
             )
-            self._slot_stage_sharding = NamedSharding(self.mesh, stage_spec)
-            slot_stage_struct = jax.ShapeDtypeStruct(
+            self._slot_stage_spec = stage_spec
+            self._slot_stage_sharding = self._kv_sharding(stage_spec)
+            slot_stage_struct = self._kv_struct(
                 (cfg.num_layers, self.cache_len, cfg.num_heads,
                  cfg.hidden_size // cfg.num_heads),
-                cfg.dtype, sharding=self._slot_stage_sharding,
+                sharding=self._slot_stage_sharding,
             )
             # Slot export reads the live cache between decode steps — the
             # cache operands are NOT donated (the stream may stay resident
@@ -1349,8 +1461,8 @@ class CausalLMEngine(_AotEngine):
                 lambda: (
                     jax.jit(sexp_fn)
                     .lower(
-                        self._cache_struct(cache_shape, cfg.dtype),
-                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._kv_struct(cache_shape),
+                        self._kv_struct(cache_shape),
                         self._rep_struct((), jnp.int32),
                     )
                     .compile()
@@ -1362,8 +1474,8 @@ class CausalLMEngine(_AotEngine):
                 lambda: (
                     jax.jit(simp_fn, donate_argnums=(0, 1, 2))
                     .lower(
-                        self._cache_struct(cache_shape, cfg.dtype),
-                        self._cache_struct(cache_shape, cfg.dtype),
+                        self._kv_struct(cache_shape),
+                        self._kv_struct(cache_shape),
                         self._rep_struct((slots,), jnp.int32),
                         slot_stage_struct,
                         slot_stage_struct,
@@ -1417,7 +1529,8 @@ class CausalLMEngine(_AotEngine):
 
     @staticmethod
     def _plan_prefix_cache(cfg, *, tp: int = 1, prefix_cache_mb: float = 0.0,
-                           block_tokens: int = 16) -> tuple[int, int]:
+                           block_tokens: int = 16,
+                           kv_dtype: str | None = None) -> tuple[int, int]:
         """Size + validate the prefix-page pool for this config/layout:
         ``(n_blocks, bytes_per_block)``. Raises ``ValueError`` loudly at
         startup (shardcheck's SC002 sweep crosses layouts with these
@@ -1433,10 +1546,18 @@ class CausalLMEngine(_AotEngine):
                 f"model axis of {tp} must divide num_heads "
                 f"({cfg.num_heads}) to shard prefix-cache pages"
             )
-        bytes_per_block = (
-            2 * cfg.num_layers * block_tokens * cfg.hidden_size
-            * jnp.dtype(cfg.dtype).itemsize
-        )
+        kv = normalize_quant_dtype(kv_dtype, "kv_dtype") \
+            or str(np.dtype(cfg.dtype).name)
+        if kv == "int8":
+            # int8 page payload + two f32 per-position scales (k and v).
+            bytes_per_block = (
+                2 * cfg.num_layers * block_tokens * (cfg.hidden_size + 4)
+            )
+        else:
+            bytes_per_block = (
+                2 * cfg.num_layers * block_tokens * cfg.hidden_size
+                * jnp.dtype(kv).itemsize
+            )
         n_blocks = int(prefix_cache_mb * 2**20 // bytes_per_block)
         if prefix_cache_mb > 0 and n_blocks < 1:
             raise ValueError(
@@ -1483,9 +1604,83 @@ class CausalLMEngine(_AotEngine):
             )
         return int(spec_tokens)
 
-    def _cache_struct(self, shape, dtype):
-        return jax.ShapeDtypeStruct(shape, dtype,
-                                    sharding=self._cache_sharding)
+    @staticmethod
+    def _plan_quant(cfg, *, tp: int = 1, weight_dtype: str | None = None,
+                    kv_dtype: str | None = None) -> tuple[str, str]:
+        """Validate the quantization knobs for this config/layout and
+        return concrete ``(weight_dtype, kv_dtype)`` names (``None`` knobs
+        resolve to the model's compute dtype). Raises ``ValueError`` loudly
+        at startup — shardcheck's SC002 quant sweep crosses these with
+        every serving layout, so an unsupported mode must reject cleanly
+        here, never surface as an XLA error mid-request. ``tp`` imposes no
+        extra constraint: packed ``_q8`` kernels shard exactly like the
+        kernels they replace, weight scales are per-last-axis-channel (the
+        axis TP splits, so each shard owns its scales), and KV scales drop
+        the sharded head axes entirely."""
+        del tp
+        w = normalize_quant_dtype(weight_dtype, "weight_dtype")
+        k = normalize_quant_dtype(kv_dtype, "kv_dtype")
+        default = str(np.dtype(cfg.dtype).name)
+        return (w or default, k or default)
+
+    # -- quantized-KV plumbing: every cache/pool/stage operand flows
+    # -- through these helpers, so int8 mode is ONE representation decision
+    # -- (the {"q","s"} pytree) instead of per-cell branching.
+
+    def _kv_wrap_spec(self, spec):
+        """shard_map spec for a KV operand: the per-position scale plane
+        drops the trailing (heads, head_dim) axes, so a TP "model" entry
+        never lands in its spec."""
+        if not self._kv_quantized:
+            return spec
+        return {"q": spec, "s": P(*tuple(spec)[:-2])}
+
+    def _kv_sharding(self, spec):
+        if not self._kv_quantized:
+            return NamedSharding(self.mesh, spec)
+        return {
+            "q": NamedSharding(self.mesh, spec),
+            "s": NamedSharding(self.mesh, P(*tuple(spec)[:-2])),
+        }
+
+    def _kv_struct(self, shape, sharding=None):
+        sharding = self._cache_sharding if sharding is None else sharding
+        if not self._kv_quantized:
+            return jax.ShapeDtypeStruct(
+                shape, self._kv_store_dtype, sharding=sharding
+            )
+        return {
+            "q": jax.ShapeDtypeStruct(
+                shape, jnp.int8, sharding=sharding["q"]
+            ),
+            "s": jax.ShapeDtypeStruct(
+                shape[:-2], jnp.float32, sharding=sharding["s"]
+            ),
+        }
+
+    def _kv_zeros(self, shape, sharding):
+        if not self._kv_quantized:
+            return jax.device_put(
+                jnp.zeros(shape, self._kv_store_dtype), sharding
+            )
+        return {
+            "q": jax.device_put(jnp.zeros(shape, jnp.int8), sharding["q"]),
+            "s": jax.device_put(
+                jnp.zeros(shape[:-2], jnp.float32), sharding["s"]
+            ),
+        }
+
+    def kv_bytes_per_token(self) -> int:
+        """Slot-cache bytes ONE cached token occupies (K + V across all
+        layers, plus scales at int8) — the `serve_kv_bytes_per_token{dtype=}`
+        gauge and DEPLOY.md's sizing math both read this."""
+        cfg = self.model.cfg
+        if self._kv_quantized:
+            return 2 * cfg.num_layers * (cfg.hidden_size + 4)
+        return (
+            2 * cfg.num_layers * cfg.hidden_size
+            * jnp.dtype(self._kv_store_dtype).itemsize
+        )
 
     def _rep_struct(self, shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype, sharding=self._rep)
@@ -1496,7 +1691,7 @@ class CausalLMEngine(_AotEngine):
         logits are identical across shards, so replicated outs are safe)."""
         if not self._model_sharded:
             return fn
-        cache, rep = self._cache_spec, P()
+        cache, rep = self._kv_wrap_spec(self._cache_spec), P()
         # (params, cache_k, cache_v, last) + the n_batch step operands.
         in_specs = (self._param_specs, cache, cache, rep) + (rep,) * n_batch
         return jax.shard_map(
@@ -1513,7 +1708,7 @@ class CausalLMEngine(_AotEngine):
         local — no cross-shard page traffic), everything else replicates."""
         if not self._model_sharded:
             return fn
-        cache, rep = self._cache_spec, P()
+        cache, rep = self._kv_wrap_spec(self._cache_spec), P()
         in_specs = (
             self._param_specs, cache, cache, rep, cache, cache,
         ) + (rep,) * 8
@@ -1528,7 +1723,7 @@ class CausalLMEngine(_AotEngine):
     def _wrap_insert(self, fn):
         if not self._model_sharded:
             return fn
-        cache, rep = self._cache_spec, P()
+        cache, rep = self._kv_wrap_spec(self._cache_spec), P()
         return jax.shard_map(
             fn,
             mesh=self.mesh,
@@ -1542,7 +1737,7 @@ class CausalLMEngine(_AotEngine):
         their head axis exactly like the pool they scatter into."""
         if not self._model_sharded:
             return fn
-        cache, rep = self._cache_spec, P()
+        cache, rep = self._kv_wrap_spec(self._cache_spec), P()
         return jax.shard_map(
             fn,
             mesh=self.mesh,
@@ -1556,7 +1751,7 @@ class CausalLMEngine(_AotEngine):
         local (the page stage splits its head axis like the pool)."""
         if not self._model_sharded:
             return fn
-        cache, rep = self._cache_spec, P()
+        cache, rep = self._kv_wrap_spec(self._cache_spec), P()
         return jax.shard_map(
             fn,
             mesh=self.mesh,
@@ -1571,8 +1766,8 @@ class CausalLMEngine(_AotEngine):
         cache spec's — per-shard gathers stay local either way."""
         if not self._model_sharded:
             return fn
-        cache, rep = self._cache_spec, P()
-        stage = P(None, None, "model", None)
+        cache, rep = self._kv_wrap_spec(self._cache_spec), P()
+        stage = self._kv_wrap_spec(P(None, None, "model", None))
         return jax.shard_map(
             fn,
             mesh=self.mesh,
@@ -1586,8 +1781,8 @@ class CausalLMEngine(_AotEngine):
         shards its head axis like the cache it scatters into."""
         if not self._model_sharded:
             return fn
-        cache, rep = self._cache_spec, P()
-        stage = P(None, None, "model", None)
+        cache, rep = self._kv_wrap_spec(self._cache_spec), P()
+        stage = self._kv_wrap_spec(P(None, None, "model", None))
         return jax.shard_map(
             fn,
             mesh=self.mesh,
@@ -1930,13 +2125,15 @@ class CausalLMEngine(_AotEngine):
         these match."""
         if self.prefix_cache is None:
             raise RuntimeError("engine has no prefix cache")
-        nl, _, bt, heads, hd = self._pool_k.shape
+        nl, _, bt, heads, hd = _kv_leaf(self._pool_k).shape
         return {
             "num_layers": int(nl),
             "block_tokens": int(bt),
             "heads": int(heads),
             "head_dim": int(hd),
-            "dtype": str(np.dtype(self._pool_k.dtype).name),
+            # int8 pools report int8 (the q payload's dtype): fp32 and int8
+            # peers must refuse each other's pages fail-closed.
+            "dtype": str(np.dtype(_kv_leaf(self._pool_k).dtype).name),
             "max_chain": int(self._max_chain),
         }
 
@@ -1987,13 +2184,13 @@ class CausalLMEngine(_AotEngine):
         its header — two engines can ship live streams between each other
         iff these match (``cache_len`` may differ: the receiver re-pads,
         refusing only streams longer than its own lanes)."""
-        nl, _, cache_len, heads, hd = self._cache_k.shape
+        nl, _, cache_len, heads, hd = _kv_leaf(self._cache_k).shape
         return {
             "num_layers": int(nl),
             "cache_len": int(cache_len),
             "heads": int(heads),
             "head_dim": int(hd),
-            "dtype": str(np.dtype(self._cache_k.dtype).name),
+            "dtype": str(np.dtype(_kv_leaf(self._cache_k).dtype).name),
         }
 
     def decode(self, lengths, active, temps, seeds) -> InFlightBatch:

@@ -89,7 +89,7 @@ class KVBlockPool:
     _RACETRACE_ATTRS = ("_free", "_by_block", "_ticks", "_evictions")
 
     def __init__(self, n_blocks: int, block_tokens: int,
-                 bytes_per_block: int = 0):
+                 bytes_per_block: int = 0, dtype: str = "float32"):
         if n_blocks < 1:
             raise ValueError(f"need at least one block, got {n_blocks}")
         if block_tokens < 1:
@@ -99,6 +99,11 @@ class KVBlockPool:
         self.n_blocks = int(n_blocks)
         self.block_tokens = int(block_tokens)
         self.bytes_per_block = int(bytes_per_block)
+        # Storage dtype of the pages this pool indexes (informational:
+        # bytes_per_block already reflects it — int8 blocks carry their
+        # per-position scale payload in the count, see engine
+        # _plan_prefix_cache).
+        self.dtype = str(dtype)
         self._lock = threading.Lock()
         self._root = _TrieNode(None, -1, None)
         self._free = list(range(self.n_blocks))
@@ -226,6 +231,7 @@ class KVBlockPool:
                 "block_tokens": self.block_tokens,
                 "blocks": self.n_blocks,
                 "blocks_used": used,
+                "dtype": self.dtype,
                 "bytes_per_block": self.bytes_per_block,
                 "bytes_used": used * self.bytes_per_block,
                 "capacity_bytes": self.n_blocks * self.bytes_per_block,

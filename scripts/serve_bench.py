@@ -1012,6 +1012,319 @@ def _run_spec_ab(args) -> dict:
     }
 
 
+def _run_quant_ab(args) -> dict:
+    """Quantized-serving A/B on a REAL tiny engine (--decode --quant).
+
+    Three measurements, all against the fp32 arm as reference:
+
+    * **quality** — a teacher-forced per-step probe: the fp32 engine's
+      greedy continuations become the reference; every step re-submits
+      the reference prefix to the int8 engine (weights AND KV int8) with
+      ``max_new_tokens=2``, so token 1 checks the prefill forward
+      (dequant-in-matmul weights) and token 2 checks a decode step read
+      from the int8 KV cache. Teacher forcing is the point: free-running
+      agreement cascades after one flip and measures luck, not error.
+      A model-level full-forward probe adds the logit MAE of int8
+      weights alone.
+    * **memory** — each arm owns a private MemoryRegistry; the /memz
+      deltas (bytes per component, ``bytes_saved_vs_fp32``) and the
+      slots-at-fixed-HBM-budget ratio (fp32 arm's slot-cache bytes
+      divided by the int8 arm's per-slot bytes) are deterministic
+      arithmetic, so the >=1.7x gate holds unconditionally.
+    * **wire** — each arm's engine+batcher mounts a real
+      ``make_kv_receiver``; a chain serialized from the OTHER arm's page
+      geometry must refuse (WireError) in both directions while the
+      same-dtype buffer adopts. Cross-dtype KV adoption fails closed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_tpu.models.causal_lm import (
+        CausalLM,
+        CausalLMConfig,
+    )
+    from distributed_tensorflow_tpu.models.quant import (
+        dequantize_params,
+        quantize_params,
+    )
+    from distributed_tensorflow_tpu.obs.memory import MemoryRegistry
+    from distributed_tensorflow_tpu.serve import (
+        BatcherConfig,
+        CausalLMEngine,
+        Client,
+    )
+    from distributed_tensorflow_tpu.serve.disagg import (
+        WireError,
+        make_kv_receiver,
+        serialize_chain,
+    )
+
+    cfg = CausalLMConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=64, max_position=64,
+    )
+    model = CausalLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+        jnp.ones((1, 8), bool),
+    )["params"]
+
+    # Model-level weight probe: full forward fp32 vs dequantized int8
+    # kernels — per-position top-1 agreement and the raw logit MAE
+    # (teacher-forced by construction: every position conditions on the
+    # given sequence, not on earlier predictions).
+    rng = np.random.default_rng(11)
+    probe = jnp.asarray(rng.integers(5, 64, size=(8, 24)), jnp.int32)
+    pmask = jnp.ones(probe.shape, bool)
+    ref_logits = model.apply({"params": params}, probe, pmask)
+    dq = dequantize_params(quantize_params(params), cfg.dtype)
+    q_logits = model.apply({"params": dq}, probe, pmask)
+    logit_mae = float(jnp.mean(jnp.abs(q_logits - ref_logits)))
+    logit_mean_abs = float(jnp.mean(jnp.abs(ref_logits)))
+    weight_top1 = float(jnp.mean(
+        (jnp.argmax(q_logits, -1) == jnp.argmax(ref_logits, -1))
+        .astype(jnp.float32)
+    ))
+
+    n_prompts = 4 if args.quick else 8
+    n_steps = 6 if args.quick else 10
+    prompts = [
+        rng.integers(5, 64, size=int(rng.integers(8, 15)))
+        for _ in range(n_prompts)
+    ]
+    slots = 4
+
+    def build_arm(weight_dtype, kv_dtype):
+        registry = MemoryRegistry()
+        engine = CausalLMEngine(
+            model, params, buckets=(16, 32), slots=slots, max_batch=2,
+            max_new_tokens=n_steps + 2, prefix_cache_mb=0.25,
+            block_tokens=4, kv_transfer=True,
+            weight_dtype=weight_dtype, kv_dtype=kv_dtype,
+            memory=registry,
+        )
+        client = Client(
+            engine, BatcherConfig(max_batch=2, max_queue=256,
+                                  max_in_flight=1),
+        )
+        return engine, client, registry
+
+    def drain(client):
+        t0 = time.monotonic()
+        futs = [
+            client.submit(
+                {"input_ids": p, "max_new_tokens": n_steps}
+            ) for p in prompts
+        ]
+        results = [f.result(timeout=600) for f in futs]
+        wall = time.monotonic() - t0
+        return results, {
+            "wall_s": wall,
+            "tokens_per_s": sum(r["n_tokens"] for r in results) / wall,
+        }
+
+    arms, engines, clients, registries = {}, {}, {}, {}
+    for name, (wd, kd) in (
+        ("fp32", (None, None)), ("int8", ("int8", "int8")),
+    ):
+        engine, client, registry = build_arm(wd, kd)
+        engines[name], clients[name], registries[name] = (
+            engine, client, registry
+        )
+        client.call(
+            {"input_ids": prompts[0], "max_new_tokens": 2}, timeout=300,
+        )  # warm the machinery before the clock starts
+        results, perf = drain(client)
+        arms[name] = {
+            "streams": [r["tokens"] for r in results],
+            **perf,
+            "weight_dtype": engine.weight_dtype,
+            "kv_dtype": engine.kv_dtype,
+            "kv_bytes_per_token": engine.kv_bytes_per_token(),
+            "slot_page_bytes": engine.slot_page_bytes,
+        }
+
+    # Teacher-forced per-step agreement through the int8 ENGINE: token 1
+    # of each probe exercises prefill (int8 weights), token 2 a decode
+    # step over the int8 KV the prefill scatter quantized.
+    agree = total = 0
+    int8_client = clients["int8"]
+    for p, ref in zip(prompts, arms["fp32"]["streams"]):
+        for t in range(len(ref) - 1):
+            forced = np.concatenate([p, np.asarray(ref[:t], np.int64)])
+            out = int8_client.call(
+                {"input_ids": forced, "max_new_tokens": 2}, timeout=300,
+            )["tokens"]
+            agree += (out[0] == ref[t]) + (out[1] == ref[t + 1])
+            total += 2
+    top1_agreement = agree / total if total else 1.0
+
+    # Memory ledger + the fixed-HBM-budget slot arithmetic. The budget is
+    # the fp32 arm's slot-cache reservation; dividing it by the int8
+    # arm's per-slot bytes says how many slots the SAME HBM would hold
+    # quantized — deterministic, so the gate is unconditional.
+    mem = {}
+    for name, registry in registries.items():
+        snap = registry.snapshot()
+        mem[name] = {
+            "components": snap["components"],
+            "component_dtypes": snap["component_dtypes"],
+            "bytes_saved_vs_fp32": snap["bytes_saved_vs_fp32"],
+            "bytes_saved_vs_fp32_total": snap["bytes_saved_vs_fp32_total"],
+        }
+    kv_budget = mem["fp32"]["components"]["kv_slot_cache"]
+    slots_at_budget = kv_budget // arms["int8"]["slot_page_bytes"]
+    slots_ratio = slots_at_budget / slots
+
+    # Wire-format cross-refusal through the REAL receivers.
+    def chain_buf(engine, seed):
+        meta = engine.page_meta()
+        wrng = np.random.default_rng(seed)
+        bt = meta["block_tokens"]
+        shape = (meta["num_layers"], 1, bt, meta["heads"],
+                 meta["head_dim"])
+
+        def side():
+            if meta["dtype"] == "int8":
+                return {
+                    "q": wrng.integers(-127, 128, shape, dtype=np.int8),
+                    "s": wrng.random(shape[:3], dtype=np.float32),
+                }
+            return wrng.random(shape, dtype=np.float32)
+
+        ids = list(wrng.integers(5, 64, size=bt))
+        return serialize_chain(
+            ids, side(), side(),
+            {k: v for k, v in meta.items() if k != "max_chain"},
+        )
+
+    receivers = {
+        name: make_kv_receiver(clients[name].batcher, engines[name])
+        for name in arms
+    }
+    cross_refusals = {}
+    for src, dst in (("int8", "fp32"), ("fp32", "int8")):
+        try:
+            receivers[dst](chain_buf(engines[src], seed=5))
+            cross_refusals[f"{src}_to_{dst}"] = "ADOPTED (FAIL)"
+        except WireError as e:
+            cross_refusals[f"{src}_to_{dst}"] = f"refused: {str(e)[:90]}"
+    same_dtype_adopts = {}
+    for name in arms:
+        out = receivers[name](chain_buf(engines[name], seed=6))
+        same_dtype_adopts[name] = out["adopted_blocks"]
+
+    for client in clients.values():
+        client.close()
+
+    for arm in arms.values():
+        arm.pop("streams")
+    return {
+        "config": {
+            "prompts": n_prompts, "steps": n_steps, "slots": slots,
+            "model": {"hidden": 32, "layers": 2, "heads": 2, "vocab": 64},
+        },
+        "arms": arms,
+        "weight_logit_mae": logit_mae,
+        "weight_logit_mean_abs": logit_mean_abs,
+        "weight_top1_agreement": weight_top1,
+        "top1_agreement": top1_agreement,
+        "teacher_forced_comparisons": total,
+        "memory": mem,
+        "kv_budget_bytes": kv_budget,
+        "slots_at_fp32_budget": int(slots_at_budget),
+        "slots_ratio": slots_ratio,
+        "wire_cross_refusals": cross_refusals,
+        "wire_same_dtype_adopted_blocks": same_dtype_adopts,
+        "tokens_per_s_ratio": (
+            arms["int8"]["tokens_per_s"] / arms["fp32"]["tokens_per_s"]
+            if arms["fp32"]["tokens_per_s"] else 1.0
+        ),
+    }
+
+
+def run_quant(args) -> int:
+    """The quantized-serving A/B (--decode --quant)."""
+    print("# quantized serving A/B: real tiny engine, fp32 vs int8 "
+          "weights + int8 KV (teacher-forced per-step agreement)")
+    q = _run_quant_ab(args)
+
+    hdr = (
+        f"{'arm':>6} {'weights':>8} {'kv':>8} {'tok/s':>8} "
+        f"{'KV B/token':>11} {'slot bytes':>11}"
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for name in ("fp32", "int8"):
+        a = q["arms"][name]
+        print(
+            f"{name:>6} {a['weight_dtype']:>8} {a['kv_dtype']:>8} "
+            f"{a['tokens_per_s']:>8.1f} {a['kv_bytes_per_token']:>11d} "
+            f"{a['slot_page_bytes']:>11d}"
+        )
+    rel_mae = (
+        q["weight_logit_mae"] / q["weight_logit_mean_abs"]
+        if q["weight_logit_mean_abs"] else 0.0
+    )
+    print(
+        f"\nquality: teacher-forced top-1 agreement "
+        f"{q['top1_agreement']:.4f} over "
+        f"{q['teacher_forced_comparisons']} step comparisons; "
+        f"weight-only full-forward top-1 "
+        f"{q['weight_top1_agreement']:.4f}, logit MAE "
+        f"{q['weight_logit_mae']:.4g} "
+        f"({100 * rel_mae:.2f}% of mean |logit|)"
+    )
+    saved = q["memory"]["int8"]["bytes_saved_vs_fp32_total"]
+    print(
+        f"memory: int8 arm saves {saved / 1024:.1f} KiB vs fp32 "
+        f"({q['memory']['int8']['bytes_saved_vs_fp32']}); at the fp32 "
+        f"arm's {q['kv_budget_bytes']} B slot-cache budget the int8 "
+        f"cache holds {q['slots_at_fp32_budget']} slots "
+        f"({q['slots_ratio']:.2f}x the configured "
+        f"{q['config']['slots']})"
+    )
+    for pair, outcome in q["wire_cross_refusals"].items():
+        print(f"wire {pair}: {outcome}")
+    print(f"wire same-dtype adoption: "
+          f"{q['wire_same_dtype_adopted_blocks']}")
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump({"mode": "quant", **q}, fh, indent=2)
+        print(f"# wrote {args.json}")
+
+    # Quality, the slot arithmetic, and wire fail-closed are
+    # UNCONDITIONAL gates (quick and full): none of them measures
+    # wall-clock, so machine load cannot excuse a miss.
+    ok = True
+    if q["top1_agreement"] < 0.99:
+        print(f"FAIL: teacher-forced top-1 agreement "
+              f"{q['top1_agreement']:.4f} < 0.99 — int8 weights+KV are "
+              "changing greedy decisions", file=sys.stderr)
+        ok = False
+    if rel_mae > 0.05:
+        print(f"FAIL: int8-weight logit MAE is {100 * rel_mae:.2f}% of "
+              "mean |logit| (>5%) — per-channel scales are off",
+              file=sys.stderr)
+        ok = False
+    if q["slots_ratio"] < 1.7:
+        print(f"FAIL: int8 KV admits only {q['slots_ratio']:.2f}x slots "
+              "at the fp32 HBM budget (<1.7x)", file=sys.stderr)
+        ok = False
+    for pair, outcome in q["wire_cross_refusals"].items():
+        if not outcome.startswith("refused"):
+            print(f"FAIL: cross-dtype KV chain {pair} was adopted — "
+                  "the wire must fail closed", file=sys.stderr)
+            ok = False
+    for name, adopted in q["wire_same_dtype_adopted_blocks"].items():
+        if adopted < 1:
+            print(f"FAIL: same-dtype chain adoption on the {name} arm "
+                  f"adopted {adopted} blocks", file=sys.stderr)
+            ok = False
+    return 0 if ok else 1
+
+
 def run_decode(args) -> int:
     """The continuous-batching decode A/B (--decode)."""
     payloads = make_decode_payloads(
@@ -3123,6 +3436,13 @@ def main(argv=None) -> int:
                    help="continuous-batching decode A/B (simulated-step "
                    "engine + real-engine parity probe) instead of the "
                    "load sweep")
+    p.add_argument("--quant", action="store_true",
+                   help="with --decode: quantized-serving A/B on a real "
+                   "tiny engine — fp32 vs int8 weights + int8 KV, "
+                   "teacher-forced top-1 agreement, /memz deltas, "
+                   "slots-at-fixed-HBM-budget, and KV-wire cross-dtype "
+                   "refusal (gates are unconditional; see DEPLOY.md "
+                   "\"Quantized serving\")")
     p.add_argument("--disagg", action="store_true",
                    help="disaggregated prefill/decode A/B: real-engine "
                    "wire-format parity probe + sim head-of-line A/B "
@@ -3204,6 +3524,8 @@ def main(argv=None) -> int:
         return run_migrate_replica(args)
     if args.migrate:
         return run_migrate(args)
+    if args.decode and args.quant:
+        return run_quant(args)
     if args.decode:
         return run_decode(args)
     if args.disagg:
