@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick disagg-quick chaos-quick fleet-quick migrate-quick quant-quick
+.PHONY: test test-all analyze analyze-diff analyze-full obs-quick decode-quick disagg-quick chaos-quick fleet-quick migrate-quick quant-quick sched-quick
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -87,6 +87,19 @@ migrate-quick:
 quant-quick:
 	$(PY) -m pytest tests/test_quant.py -q
 	$(PY) scripts/serve_bench.py --decode --quant --quick
+
+# Priority-preemptive scheduling gate (~1 min): the EDF/preemption unit +
+# parity suite (preempt -> park -> resume bit-parity composed with prefix
+# cache, speculation, and int8 KV; mid-prefill-chunk preemption;
+# park-pool-full degradation; derived Retry-After arithmetic; race soak),
+# then the serve_bench --sched A/B — real-engine forced-preemption parity
+# probe (unconditional), and FIFO vs EDF+preempt on a heavy-tailed
+# mixed-priority workload (urgent TTFT p99 <=0.7x FIFO, deadline
+# attainment >=+0.2, >=1 park; best-of-3 on timing, parity
+# unconditional). docs/DEPLOY.md "Priority & preemption", docs/PERF.md r20.
+sched-quick:
+	$(PY) -m pytest tests/test_sched.py -q
+	$(PY) scripts/serve_bench.py --sched --quick
 
 # Static analysis + config sweep over the package; nonzero exit on any
 # non-baselined finding or stale baseline entry.

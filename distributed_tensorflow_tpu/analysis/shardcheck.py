@@ -118,6 +118,25 @@ DISAGG_VARIANTS: tuple[tuple[int, int, int], ...] = (
     (0, 1, 1),   # invalid: must reject with a clean ValueError
 )
 
+# Priority-scheduling configurations swept once per run (the scheduler
+# plan is layout-independent): (sched, preempt, preempt_margin_ms,
+# default_priority). Every combination must either plan (BatcherConfig
+# constructs) or reject with a clean ValueError at config time — a knob
+# combo that only dies when the decode loop first preempts would strand
+# live streams. The invalid rows pin the designed rejections: preemption
+# without EDF (FIFO cannot order deadline waiters), an unknown policy,
+# a negative margin, and a negative default class.
+SCHED_VARIANTS: tuple[tuple[str, bool, float, int], ...] = (
+    ("fifo", False, 20.0, 1),   # the defaults: must plan
+    ("edf", False, 20.0, 0),    # ordering without preemption: must plan
+    ("edf", True, 20.0, 1),     # the full feature: must plan
+    ("edf", True, 0.0, 0),      # zero margin (preempt at the deadline)
+    ("fifo", True, 20.0, 1),    # preempt needs edf: must reject
+    ("lifo", False, 20.0, 1),   # unknown policy: must reject
+    ("edf", True, -5.0, 1),     # negative margin: must reject
+    ("edf", False, 20.0, -1),   # negative default class: must reject
+)
+
 # Mesh layouts exercised by tests/test_serve_mesh.py plus the CLI default
 # and the documented fallback probes, as (tp, pp, ep) on 8 devices.
 DEFAULT_LAYOUTS: tuple[tuple[int, int, int], ...] = (
@@ -244,6 +263,103 @@ def run_config_sweep(
 
     findings: list[Finding] = []
     matrix: list[dict] = []
+    # Scheduler knob sweep (serve/batcher.py): layout-independent, so it
+    # runs once, not per cell. Each variant must construct a BatcherConfig
+    # or reject with a clean ValueError; the batcher classes that cannot
+    # honor a policy (DynamicBatcher reorders nothing, flush admission
+    # preempts nothing) must reject the config at BUILD time, before any
+    # scheduler thread exists.
+    from ..serve.batcher import (
+        BatcherConfig,
+        ContinuousBatcher,
+        DynamicBatcher,
+    )
+
+    class _NullEngine:  # attribute surface only; never dispatched
+        slots = 1
+        max_batch = 1
+
+    # "outcome" keeps the cell uniform with the layout cells for the
+    # sweep summary; the per-variant plans/rejects verdicts live inside.
+    sched_cell: dict = {"sweep": "sched", "outcome": "sched_variants",
+                        "variants": []}
+    for sched, preempt, margin, default_pri in SCHED_VARIANTS:
+        row: dict = {
+            "sched": sched, "preempt": preempt,
+            "preempt_margin_ms": margin, "default_priority": default_pri,
+        }
+        try:
+            cfg = BatcherConfig(
+                sched=sched, preempt=preempt, preempt_margin_ms=margin,
+                default_priority=default_pri,
+            )
+        except ValueError as exc:
+            row["rejects"] = str(exc)
+            sched_cell["variants"].append(row)
+            continue
+        except Exception as exc:
+            findings.append(
+                Finding(
+                    check="SC002",
+                    path="distributed_tensorflow_tpu/serve/batcher.py",
+                    line=0,
+                    scope="BatcherConfig",
+                    message=(
+                        f"sched variant sched={sched} preempt={preempt} "
+                        f"margin={margin} default_priority={default_pri} "
+                        f"raised {type(exc).__name__} instead of a clean "
+                        f"ValueError: {exc}"
+                    ),
+                )
+            )
+            row["raised"] = type(exc).__name__
+            sched_cell["variants"].append(row)
+            continue
+        row["plans"] = True
+        if cfg.sched != "fifo":
+            # The flush batcher holds no slots: a non-FIFO policy must be
+            # rejected before its flusher thread ever starts.
+            try:
+                DynamicBatcher(lambda p: [{} for _ in p], cfg)
+                findings.append(
+                    Finding(
+                        check="SC002",
+                        path="distributed_tensorflow_tpu/serve/batcher.py",
+                        line=0,
+                        scope="DynamicBatcher",
+                        message=(
+                            f"DynamicBatcher accepted sched={cfg.sched!r} "
+                            f"— the flush batcher cannot reorder or "
+                            f"preempt and must reject at build time"
+                        ),
+                    )
+                )
+                row["dynamic_accepts"] = True
+            except ValueError as exc:
+                row["dynamic_rejects"] = str(exc)
+        if cfg.preempt:
+            # Flush admission only ever fills an empty table: preemption
+            # there must be a clean build-time rejection too.
+            try:
+                ContinuousBatcher(_NullEngine(), cfg, admission="flush")
+                findings.append(
+                    Finding(
+                        check="SC002",
+                        path="distributed_tensorflow_tpu/serve/batcher.py",
+                        line=0,
+                        scope="ContinuousBatcher",
+                        message=(
+                            "ContinuousBatcher accepted preempt=True with "
+                            "flush admission — there is never an occupied "
+                            "slot to preempt for a waiter"
+                        ),
+                    )
+                )
+                row["flush_accepts"] = True
+            except ValueError as exc:
+                row["flush_rejects"] = str(exc)
+        sched_cell["variants"].append(row)
+    matrix.append(sched_cell)
     # Every preset with a transformer serving path: BERT one-shot scoring
     # AND the causal-LM decode engines — a decode layout that only dies at
     # executable build time is exactly the raw-XLA-error class SC002 exists

@@ -249,6 +249,10 @@ def build_serving_client(cfg, args):
             max_queue=args.max_queue,
             max_in_flight=args.max_in_flight,
             bucket_queues=args.bucket_queues,
+            sched=getattr(args, "sched", "fifo"),
+            preempt=getattr(args, "preempt", False),
+            preempt_margin_ms=getattr(args, "preempt_margin_ms", 20.0),
+            default_priority=getattr(args, "default_priority", 1),
         ),
         tracer=Tracer(buffer_size=buf, enabled=buf > 0),
         slo=slo,
@@ -413,6 +417,30 @@ def main(argv: list[str] | None = None):
                         help="admit new requests only when the slot table "
                         "is EMPTY (static batching; the A/B baseline for "
                         "continuous admission)")
+    # Priority-preemptive scheduling (see DEPLOY.md "Priority &
+    # preemption"): requests may carry "priority" (class 0 = most urgent)
+    # and "deadline_ms" (TTFT deadline relative to enqueue) on
+    # /v1/generate; EDF admission orders the queue by them, and --preempt
+    # parks a lower-priority slot (KV lanes into prefix-pool pages,
+    # resume via resume_tokens replay) when a deadline would be missed.
+    parser.add_argument("--sched", default="fifo",
+                        choices=["fifo", "edf"],
+                        help="admission order: fifo (arrival) or edf "
+                        "(earliest deadline first within priority class)")
+    parser.add_argument("--preempt", action="store_true",
+                        help="preempt a lower-priority decode slot when a "
+                        "queued deadline holder would otherwise miss its "
+                        "deadline (requires --sched edf; preempted "
+                        "streams resume bit-identically)")
+    parser.add_argument("--preempt-margin-ms", type=float, default=20.0,
+                        help="preempt when now + margin crosses a queued "
+                        "request's deadline — headroom for the park + "
+                        "re-prefill round trip")
+    parser.add_argument("--default-priority", type=int, default=1,
+                        help="priority class for requests that don't send "
+                        "one (0 = most urgent; keep the default above 0 "
+                        "so explicit high-priority traffic can outrank "
+                        "the unlabelled crowd)")
     # Multi-chip serving mesh (BERT engines; see DEPLOY.md "Multi-chip
     # serving"). A layout that doesn't fit the device count falls back to
     # single-chip DP with a warning.

@@ -257,6 +257,20 @@ def serve_families(
         migrations.add(v, {"outcome": outcome})
     fams.append(migrations)
 
+    # Priority-preemptive scheduling (serve/batcher.py): parks by how they
+    # went ("paged"/"pageless"/aborts) and live queue depth per priority
+    # class (label "0" is the most urgent).
+    preempts = Family("serve_preemptions_total", "counter",
+                      "slot preemptions (parks + aborted parks) by reason")
+    for reason, v in m.preemptions.snapshot().items():
+        preempts.add(v, {"reason": reason})
+    fams.append(preempts)
+    sched_depth = Family("serve_sched_queue_depth", "gauge",
+                         "queued requests per priority class")
+    for cls, v in m.sched_queue_depth.snapshot().items():
+        sched_depth.add(v, {"class": cls})
+    fams.append(sched_depth)
+
     # Sample-ring quantile gauges (legacy estimator; ms families in the
     # JSON snapshot stay seconds here — exposition is SI).
     fams.append(_summary_quantiles(

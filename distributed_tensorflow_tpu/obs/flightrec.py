@@ -80,6 +80,12 @@ EVENT_KINDS = (
     "stream_export",        # live stream checkpointed off its slot/queue
     "stream_adopt",         # migrated stream resumed here (pages yes/no)
     "stream_migrate_reject",  # wire/geometry/state/budget refusal (cause)
+    # -- priority-preemptive scheduling (serve/batcher.py) --
+    "slot_preempt",         # victim parked (reason paged/pageless) or the
+                            # park aborted (aborted=True: pool full /
+                            # un-bucketable resume; victim finishes)
+    "slot_resume",          # parked victim re-admitted (resume_tokens
+                            # replay; rounds = parks survived)
     "dump",
 )
 

@@ -400,6 +400,15 @@ class ServeMetrics:
         # migrate_streams), keyed by outcome: "adopted"/"rejected" on the
         # receiving replica, "migrated"/"readopted" on the exporting one.
         self.stream_migrations = LabelledCounter()
+        # Priority-preemptive scheduling (serve/batcher.py), keyed by how
+        # the park went: "paged" (KV lanes published into parked pool
+        # pages), "pageless" (resume_tokens replay only), or the abort
+        # reasons "park_full"/"bucket_overflow" (victim kept its slot and
+        # finished). serve_preemptions_total in prom.
+        self.preemptions = LabelledCounter()
+        # Queued requests per priority class (label = class number as a
+        # string; 0 is the most urgent). serve_sched_queue_depth in prom.
+        self.sched_queue_depth = LabelledGauge()
         # ------------------------------------------------ windowed families
         # (obs/timeseries.py) — the SLO/health layer's inputs.  bad_w
         # counts requests that burned availability budget (backpressure +
@@ -505,6 +514,8 @@ class ServeMetrics:
             "kv_transfer_bytes": self.kv_transfer_bytes.snapshot(),
             "kv_transfer_seconds": self.kv_transfer_seconds.snapshot(),
             "stream_migrations": self.stream_migrations.snapshot(),
+            "preemptions": self.preemptions.snapshot(),
+            "sched_queue_depth": self.sched_queue_depth.snapshot(),
             "ttft_ms": {
                 k: (v * 1e3 if k != "count" else v)
                 for k, v in self.ttft.summary().items()

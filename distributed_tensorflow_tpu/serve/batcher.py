@@ -57,6 +57,32 @@ class Backpressure(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+def drain_retry_after_s(
+    queued_units: float,
+    unit_rate: float,
+    floor_s: float,
+    cap_s: float = 30.0,
+) -> float:
+    """Retry-After for an admission shed, from actual drain arithmetic.
+
+    ``queued_units / unit_rate`` is how long the work already queued takes
+    to drain at the recently observed service rate (units and rate must
+    agree: tokens owed over tokens/s for the continuous batcher, requests
+    over requests/s for the flush batcher). Floored at ``floor_s`` (one
+    flush window — the old fixed hint — so an idle or just-started server
+    never hands out a zero), capped at ``cap_s`` so a momentary stall
+    can't tell clients to go away for minutes. A non-positive rate means
+    nothing has drained inside the measurement window; the floor is the
+    only honest answer then.
+    """
+    if unit_rate <= 0.0 or queued_units <= 0.0:
+        return floor_s
+    return min(max(queued_units / unit_rate, floor_s), cap_s)
+
+
+VALID_SCHED = ("fifo", "edf")
+
+
 @dataclasses.dataclass(frozen=True)
 class BatcherConfig:
     max_batch: int = 8          # flush when this many requests are queued
@@ -65,6 +91,17 @@ class BatcherConfig:
     max_in_flight: int = 2      # dispatched-not-fetched batches (needs an
                                 # engine with dispatch/fetch; else 1)
     bucket_queues: bool = False  # per-bucket queues (needs bucket_for)
+    sched: str = "fifo"         # admission order: "fifo" | "edf"
+                                # (earliest-deadline-first within priority
+                                # class; continuous batcher only)
+    preempt: bool = False       # evict a lower-priority slot when a queued
+                                # higher-priority request would miss its
+                                # deadline (needs sched="edf")
+    preempt_margin_ms: float = 20.0  # preempt when now + margin crosses the
+                                # waiter's deadline (headroom for the park/
+                                # re-prefill round trip)
+    default_priority: int = 1   # class for requests that don't send one
+                                # (0 is the most urgent; larger = later)
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -77,17 +114,45 @@ class BatcherConfig:
             raise ValueError(
                 f"max_in_flight must be >= 1, got {self.max_in_flight}"
             )
+        if self.sched not in VALID_SCHED:
+            raise ValueError(
+                f"sched must be one of {VALID_SCHED}, got {self.sched!r}"
+            )
+        if self.preempt and self.sched != "edf":
+            raise ValueError(
+                "preempt=True requires sched='edf' — preemption exists to "
+                "rescue deadline-bearing waiters, which FIFO cannot order"
+            )
+        if self.preempt_margin_ms < 0:
+            raise ValueError("preempt_margin_ms must be >= 0")
+        if self.default_priority < 0:
+            raise ValueError(
+                f"default_priority must be >= 0, got {self.default_priority}"
+            )
 
 
 class _Pending:
-    __slots__ = ("payload", "future", "t_enqueue", "t_taken", "request_id")
+    __slots__ = (
+        "payload", "future", "t_enqueue", "t_taken", "request_id",
+        "priority", "deadline_abs", "preempted",
+    )
 
-    def __init__(self, payload, request_id=None):
+    def __init__(self, payload, request_id=None, default_priority=0):
         self.payload = payload
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
         self.t_taken = 0.0          # stamped when the flusher takes the batch
         self.request_id = request_id
+        # DynamicBatcher accepts arbitrary payloads (any object run_batch
+        # understands); only mapping payloads can carry scheduling fields.
+        fields = payload if isinstance(payload, dict) else {}
+        self.priority = int(fields.get("priority", default_priority))
+        # Absolute TTFT deadline (monotonic clock); None = best-effort.
+        ddl = fields.get("deadline_ms")
+        self.deadline_abs = (
+            self.t_enqueue + float(ddl) / 1e3 if ddl is not None else None
+        )
+        self.preempted = 0          # park/resume round trips survived
 
 
 class DynamicBatcher:
@@ -120,6 +185,12 @@ class DynamicBatcher:
         layout: str = "",
     ):
         self.config = config or BatcherConfig()
+        if self.config.sched != "fifo":
+            raise ValueError(
+                "DynamicBatcher flushes whole batches and holds no slots to "
+                "reorder or preempt; sched policies need the continuous "
+                f"batcher (got sched={self.config.sched!r})"
+            )
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.recorder = recorder if recorder is not None else NULL_RECORDER
@@ -196,9 +267,14 @@ class DynamicBatcher:
                     "request_reject", request_id, cause="backpressure",
                     queue_depth=self._count,
                 )
-                # One flush window, floored at 1 ms so a zero-delay config
-                # still hands clients a usable (non-zero) retry hint.
-                exc = Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
+                # Drain-time hint: queued requests over the recent
+                # completion rate, floored at one flush window (1 ms min
+                # so a zero-delay config still hands out a non-zero hint).
+                exc = Backpressure(drain_retry_after_s(
+                    float(self._count),
+                    self.metrics.ok_w.rate(10.0),
+                    max(self.config.max_delay_ms / 1e3, 1e-3),
+                ))
                 exc.request_id = request_id
                 raise exc
             pending = _Pending(payload, request_id)
@@ -539,7 +615,8 @@ class _Slot:
         "temperature", "seed", "tokens", "n_dispatched", "t_first",
         "t_last_tok", "prefilling", "chunk_pos", "cached_len", "chain",
         "slot_id", "spec", "prompt_ids", "draft", "verifying",
-        "resume", "full_prompt", "admit_len",
+        "resume", "full_prompt", "admit_len", "preempting",
+        "preempt_exempt",
     )
 
     def __init__(self, pending: _Pending, gen: int, payload: dict,
@@ -587,6 +664,12 @@ class _Slot:
         self.prompt_ids: list[int] = []
         self.draft: list[int] | None = None
         self.verifying = False
+        # Priority-preemption bookkeeping: a marked victim stops taking
+        # new decode/verify/chunk dispatches and parks once its in-flight
+        # steps settle; an exempt slot was chosen once but could not park
+        # (pool full, un-bucketable resume) and runs to completion.
+        self.preempting = False
+        self.preempt_exempt = False
 
 
 @dataclasses.dataclass
@@ -747,6 +830,8 @@ class ContinuousBatcher:
         "_queue", "_count", "_closed", "_slots", "_n_active", "_n_inflight",
         "_steps", "_tokens_emitted", "_spec_drafted", "_spec_accepted",
         "_spec_rejects", "_adoptions", "_stream_adopts", "_export_req",
+        "_class_queued", "_preempt_parked", "_preempt_resumed",
+        "_preempt_aborted",
     )
 
     def __init__(
@@ -765,6 +850,12 @@ class ContinuousBatcher:
                 f"admission must be 'continuous' or 'flush', got {admission!r}"
             )
         self.config = config or BatcherConfig()
+        if self.config.preempt and admission != "continuous":
+            raise ValueError(
+                "preempt=True requires admission='continuous' — flush "
+                "admission only ever fills an empty table, so there is "
+                "never an occupied slot to preempt for a waiter"
+            )
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.recorder = recorder if recorder is not None else NULL_RECORDER
@@ -843,6 +934,13 @@ class ContinuousBatcher:
         # on the decode-step dispatch clock. None = no chaos.
         self.fault_injector = None
         self._dispatched_steps = 0
+        # Priority scheduling state (all under _cv): per-class queued
+        # counts backing the serve_sched_queue_depth gauge, plus lifetime
+        # park / resume / aborted-park totals for status()["sched"].
+        self._class_queued: dict[int, int] = {}
+        self._preempt_parked = 0
+        self._preempt_resumed = 0
+        self._preempt_aborted = 0
         self._count = 0
         self._served = 0             # lifetime completed requests
         self._closed = False
@@ -893,13 +991,31 @@ class ContinuousBatcher:
                     "request_reject", request_id, cause="backpressure",
                     queue_depth=self._count,
                 )
-                exc = Backpressure(max(self.config.max_delay_ms / 1e3, 1e-3))
+                # Drain-time hint: tokens the queue still owes over the
+                # recent token rate — a queue of heavy generations backs
+                # clients off longer than the same depth of light ones.
+                exc = Backpressure(drain_retry_after_s(
+                    float(sum(
+                        max(
+                            1,
+                            int(q.payload.get(
+                                "max_new_tokens", self._default_max_new
+                            )) - len(q.payload.get("resume_tokens", ())
+                                     or ()),
+                        )
+                        for q in self._queue
+                    )),
+                    self.metrics.tokens_w.rate(10.0),
+                    max(self.config.max_delay_ms / 1e3, 1e-3),
+                ))
                 exc.request_id = request_id
                 raise exc
-            pending = _Pending(payload, request_id)
+            pending = _Pending(payload, request_id,
+                               self.config.default_priority)
             pending.future.request_id = request_id
             self._queue.append(pending)
             self._count += 1
+            self._class_delta(pending.priority, +1)
             metrics.requests.inc()
             metrics.queue_depth.set(self._count)
             self._cv.notify_all()
@@ -1117,31 +1233,104 @@ class ContinuousBatcher:
                         if self._spec_drafted else 0.0
                     ),
                 }
+            # Priority-scheduling digest for /statusz + the fleet view:
+            # policy knobs, per-class queue depth and slot occupancy, and
+            # lifetime park / resume / aborted-park totals.
+            classes: dict[int, dict] = {}
+            for pri, n in self._class_queued.items():
+                classes.setdefault(pri, {"queued": 0, "active": 0})
+                classes[pri]["queued"] = n
+            preempting_now = 0
+            for s in self._slots:
+                if s is None:
+                    continue
+                pri = s.pending.priority
+                classes.setdefault(pri, {"queued": 0, "active": 0})
+                classes[pri]["active"] += 1
+                if s.preempting:
+                    preempting_now += 1
+            out["sched"] = {
+                "policy": self.config.sched,
+                "preempt": self.config.preempt,
+                "preempt_margin_ms": self.config.preempt_margin_ms,
+                "classes": {str(k): v for k, v in sorted(classes.items())},
+                "preempting_now": preempting_now,
+                "parked_waiting": sum(1 for q in self._queue if q.preempted),
+                "preempt_parked": self._preempt_parked,
+                "preempt_resumed": self._preempt_resumed,
+                "preempt_aborted": self._preempt_aborted,
+            }
             return out
 
     # --------------------------------------------------------- decode loop
+
+    def _class_delta(self, priority: int, d: int) -> None:
+        """Queue-change bookkeeping for one priority class (under ``_cv``):
+        keeps the per-class counts and the ``serve_sched_queue_depth``
+        gauge in lockstep with the queue itself."""
+        n = self._class_queued.get(priority, 0) + d
+        if n <= 0:
+            self._class_queued.pop(priority, None)
+            n = 0
+        else:
+            self._class_queued[priority] = n
+        self.metrics.sched_queue_depth.set(str(priority), n)
+
+    def _clear_queue_classes(self) -> None:
+        """Zero every per-class gauge after a bulk queue strip (stream
+        export, non-drain close)."""
+        for pri in list(self._class_queued):
+            self.metrics.sched_queue_depth.set(str(pri), 0)
+        self._class_queued.clear()
+
+    def _pop_next_locked(self) -> _Pending:
+        """Take the next admission from the queue under the configured
+        policy. FIFO pops the head; EDF scans for the most urgent entry —
+        lowest priority class first, earliest deadline within the class
+        (deadline-less entries sort behind every deadline holder), FIFO
+        order as the final tie-break. O(queue) per admission, bounded by
+        ``max_queue``."""
+        if self.config.sched == "fifo" or len(self._queue) == 1:
+            p = self._queue.popleft()
+        else:
+            best_ix, best_key = 0, None
+            for ix, q in enumerate(self._queue):
+                key = (
+                    q.priority,
+                    q.deadline_abs if q.deadline_abs is not None
+                    else float("inf"),
+                    q.t_enqueue,
+                )
+                if best_key is None or key < best_key:
+                    best_key, best_ix = key, ix
+            p = self._queue[best_ix]
+            del self._queue[best_ix]
+        self._class_delta(p.priority, -1)
+        return p
 
     def _steppable(self, s: _Slot | None) -> bool:
         """Include the slot in the next decode step? Occupied, fully
         prefilled, and not every requested token already dispatched (a
         slot whose last tokens are still in flight rides along inactive
         until they fetch). A slot with a verify step in flight is parked
-        until the verdict lands."""
+        until the verdict lands, and a preemption victim stops taking new
+        steps so its in-flight work can settle and park."""
         return (
             s is not None
             and not s.prefilling
             and not s.verifying
+            and not s.preempting
             and s.n_dispatched < s.max_new
         )
 
     def _take_work(self):
         """Block until there is something to dispatch; returns ``("work",
-        admissions, chunk_rows, step, verify, adopts, stream_rows)`` — any
-        may be empty/None — or ``("export", ...)`` when a stream export
-        quiesced, or None when closed and fully drained. All bookkeeping
-        (slot
-        assignment, trie match, chunk/length advance, draft assembly)
-        happens HERE under ``_cv``; the caller just dispatches.
+        admissions, chunk_rows, step, verify, adopts, stream_rows,
+        park_rows)`` — any may be empty/None — or ``("export", ...)`` when
+        a stream export quiesced, or None when closed and fully drained.
+        All bookkeeping (slot assignment, trie match, chunk/length
+        advance, draft assembly, preemption mark/park) happens HERE under
+        ``_cv``; the caller just dispatches.
 
         On a chunked engine an admission does NOT dispatch a prefill:
         the slot enters ``prefilling`` (its prompt possibly shortened by a
@@ -1202,6 +1391,7 @@ class ContinuousBatcher:
                         exported.append((i, s))
                     queued = list(self._queue)
                     self._queue.clear()
+                    self._clear_queue_classes()
                     adopts_q = list(self._stream_adopts)
                     self._stream_adopts.clear()
                     self._count = 0
@@ -1258,6 +1448,163 @@ class ContinuousBatcher:
                     stream_rows.append((free_ix, slot, pk, pv))
                 if stream_rows:
                     metrics.slots_active.set(self._n_active)
+                # -------------------------------------- priority preemption
+                # MARK: when a queued deadline holder would miss its
+                # deadline waiting for a natural slot free, pick a strictly
+                # lower-priority occupant per uncovered urgent waiter and
+                # flag it. A marked victim takes no further chunk/verify/
+                # decode dispatches (see _steppable); it PARKS below once
+                # its in-flight steps settle. Already-marked and exempt
+                # slots count as arriving capacity, so one waiter never
+                # marks the whole table.
+                if self.config.preempt and self._queue:
+                    free_n = sum(1 for s in self._slots if s is None)
+                    marked_n = sum(
+                        1 for s in self._slots
+                        if s is not None and s.preempting
+                    )
+                    now = time.monotonic()
+                    margin = self.config.preempt_margin_ms / 1e3
+                    urgent = sorted(
+                        (q for q in self._queue
+                         if q.deadline_abs is not None
+                         and now + margin >= q.deadline_abs),
+                        key=lambda q: (q.priority, q.deadline_abs,
+                                       q.t_enqueue),
+                    )
+                    need = len(urgent) - free_n - marked_n
+                    for w in urgent:
+                        if need <= 0:
+                            break
+                        victim = None
+                        for s in self._slots:
+                            if (
+                                s is None
+                                or s.preempting
+                                or s.preempt_exempt
+                                or s.pending.priority <= w.priority
+                            ):
+                                continue
+                            # Lowest-urgency class first; within it, the
+                            # occupant with the least generated progress
+                            # (cheapest park + re-prefill round trip).
+                            if victim is None or (
+                                s.pending.priority,
+                                -len(s.tokens),
+                            ) > (
+                                victim.pending.priority,
+                                -len(victim.tokens),
+                            ):
+                                victim = s
+                        if victim is None:
+                            continue
+                        victim.preempting = True
+                        need -= 1
+                # PARK: settle-and-evict every marked victim whose steps
+                # have landed. The victim's client future survives — its
+                # _Pending re-enqueues with the generated tokens as
+                # resume_tokens (the PR 18 replay contract: bit-identical
+                # by (seed, absolute position) sampling) — and, when the
+                # prefix pool can hold the full settled sequence, the
+                # slot's KV lane publishes into parked pool pages first so
+                # the resume's re-prefill is a near-pure cache hit. A pool
+                # too full to cover the whole parked sequence ABORTS the
+                # preemption instead (the victim finishes; it is never
+                # lost) — re-prefilling against garbage or half-parked
+                # pages is how bit-parity dies.
+                park_rows = []
+                if self.config.preempt:
+                    for i, s in enumerate(self._slots):
+                        if s is None or not s.preempting:
+                            continue
+                        if s.prefilling:
+                            # Mid-prefill victims park page-less NOW: any
+                            # in-flight chunk's completion drops on the
+                            # gen tag, nothing generated is lost (tokens
+                            # == the resume prefix it arrived with), and
+                            # the pinned prefix match unpins below.
+                            settled = True
+                        else:
+                            settled = (
+                                not s.verifying
+                                and s.n_dispatched == len(s.tokens)
+                            )
+                        if not settled:
+                            continue
+                        p = s.pending
+                        reason, new_blocks = "pageless", []
+                        if (
+                            not s.prefilling
+                            and s.tokens
+                            and self._pool is not None
+                            and callable(getattr(
+                                self._engine, "insert_prefix", None
+                            ))
+                        ):
+                            # Settled lane covers positions 0..length-1
+                            # (the newest token's KV is written by the
+                            # step that was never dispatched).
+                            key = (
+                                s.full_prompt + s.tokens[len(s.resume):]
+                            )[: s.length]
+                            cap = getattr(self._engine, "_max_chain", None)
+                            if cap is not None:
+                                key = key[: cap * self._pool.block_tokens]
+                            want = len(key) // self._pool.block_tokens
+                            if want > 0:
+                                # Lock order _cv -> pool, same as the
+                                # admission trie match.
+                                new_blocks, covered = self._pool.index(key)
+                                if covered >= want:
+                                    reason = "paged"
+                                else:
+                                    # Park-pool-full: whatever prefix DID
+                                    # index still gets its page copy below
+                                    # (it is valid data the pool now
+                                    # advertises), but the victim keeps
+                                    # its slot and finishes. Exempt, so
+                                    # the next pass marks someone else.
+                                    s.preempting = False
+                                    s.preempt_exempt = True
+                                    self._preempt_aborted += 1
+                                    park_rows.append(
+                                        ("abort", i, s, "park_full",
+                                         new_blocks)
+                                    )
+                                    continue
+                        if reason == "pageless" and not self._chunked:
+                            # Monolithic prefill buckets the resumed
+                            # prompt (original + every generated token);
+                            # an un-bucketable resume cannot replay here.
+                            try:
+                                self._engine.bucket_for(
+                                    s.prompt_len + len(s.tokens)
+                                )
+                            except Exception:  # noqa: BLE001
+                                s.preempting = False
+                                s.preempt_exempt = True
+                                self._preempt_aborted += 1
+                                park_rows.append(
+                                    ("abort", i, s, "bucket_overflow", [])
+                                )
+                                continue
+                        pl = dict(p.payload)
+                        if s.tokens:
+                            pl["resume_tokens"] = [int(t) for t in s.tokens]
+                        p.payload = pl
+                        p.preempted += 1
+                        self._slots[i] = None
+                        self._n_active -= 1
+                        if self._pool is not None and s.chain is not None:
+                            self._pool.release(s.chain)  # idempotent unpin
+                        self._queue.append(p)
+                        self._count += 1
+                        self._class_delta(p.priority, +1)
+                        self._preempt_parked += 1
+                        park_rows.append(("park", i, s, reason, new_blocks))
+                    if park_rows:
+                        metrics.queue_depth.set(self._count)
+                        metrics.slots_active.set(self._n_active)
                 admissions = []
                 free = [
                     i for i, s in enumerate(self._slots) if s is None
@@ -1269,8 +1616,10 @@ class ContinuousBatcher:
                     now = time.monotonic()
                     for slot_id in free[: min(len(self._queue),
                                               self._admit_cap)]:
-                        p = self._queue.popleft()
+                        p = self._pop_next_locked()
                         self._count -= 1
+                        if p.preempted:
+                            self._preempt_resumed += 1
                         p.t_taken = now  # queue_wait phase ends here
                         slot = _Slot(
                             p, next(self._gens), p.payload,
@@ -1440,9 +1789,9 @@ class ContinuousBatcher:
                             s.spec.note_plain_step()  # probe clock
                     step = (lengths, active, temps, seeds, tags)
                 if (admissions or chunk_rows or step or verify or adopts
-                        or stream_rows):
+                        or stream_rows or park_rows):
                     return ("work", admissions, chunk_rows, step, verify,
-                            adopts, stream_rows)
+                            adopts, stream_rows, park_rows)
                 self._cv.wait()
 
     def _fail_slots(self, tagged: list[tuple[int, int]],
@@ -1499,9 +1848,8 @@ class ContinuousBatcher:
                 _, req, exported, queued, adopts_q = work
                 self._service_export(req, exported, queued, adopts_q)
                 continue
-            _, admissions, chunk_rows, step, verify, adopts, stream_rows = (
-                work
-            )
+            (_, admissions, chunk_rows, step, verify, adopts, stream_rows,
+             park_rows) = work
             if stream_rows:
                 # Slot-page import dispatches FIRST: the adopted slots may
                 # already ride this pass's verify/decode step, and stream
@@ -1540,6 +1888,48 @@ class ContinuousBatcher:
                     else:
                         if not fut.cancelled():
                             fut.set_result(len(new))
+            if park_rows:
+                # Park-publish dispatches BEFORE any admission prefill or
+                # chunk gather this pass: insert_prefix copies the parked
+                # victim's lane pages into its freshly indexed pool blocks,
+                # and stream order guarantees the copy reads the lane (and
+                # fills the blocks a same-pass re-admission may already
+                # have matched) before anything overwrites or gathers
+                # them. Bookkeeping already happened under _cv.
+                for what, slot_id, s, reason, new_blocks in park_rows:
+                    if new_blocks:
+                        try:
+                            engine.insert_prefix(slot_id, new_blocks)
+                        except Exception:  # noqa: BLE001 — pool keeps the
+                            # blocks; their bytes are stale, so drop them
+                            # from the trie rather than serve garbage.
+                            logger.exception(
+                                "park-publish of slot %d failed; evicting "
+                                "the parked chain", slot_id,
+                            )
+                            self._pool.forget(
+                                (s.full_prompt + s.tokens[len(s.resume):])
+                                [: s.length]
+                            )
+                        else:
+                            self.metrics.kv_pool_bytes.set(
+                                self._pool.stats()["bytes_used"]
+                            )
+                    if what == "park":
+                        self.metrics.preemptions.inc(reason)
+                        self.recorder.record(
+                            "slot_preempt", s.pending.request_id,
+                            slot=slot_id, reason=reason,
+                            n_tokens=len(s.tokens),
+                            parked_blocks=len(new_blocks),
+                        )
+                    else:
+                        self.metrics.preemptions.inc(reason)
+                        self.recorder.record(
+                            "slot_preempt", s.pending.request_id,
+                            slot=slot_id, reason=reason, aborted=True,
+                            n_tokens=len(s.tokens),
+                        )
             if self._plan_events:
                 # Backoff flips noted while planning (same thread, so no
                 # lock needed); recorded here, outside _cv.
@@ -1560,6 +1950,13 @@ class ContinuousBatcher:
                             "slot_alloc", s.pending.request_id,
                             slot=i, prompt_len=s.prompt_len,
                         )
+                        if s.pending.preempted:
+                            self.recorder.record(
+                                "slot_resume", s.pending.request_id,
+                                slot=i, rounds=s.pending.preempted,
+                                resume_tokens=len(s.resume),
+                                cached_tokens=s.cached_len,
+                            )
                         if s.cached_len:
                             self.recorder.record(
                                 "prefix_hit", s.pending.request_id,
@@ -2068,6 +2465,7 @@ class ContinuousBatcher:
                 while self._queue:
                     p = self._queue.popleft()
                     p.future.set_exception(RuntimeError("batcher closed"))
+                self._clear_queue_classes()
                 while self._stream_adopts:
                     *_, fut = self._stream_adopts.popleft()
                     if not fut.cancelled():

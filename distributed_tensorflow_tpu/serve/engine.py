@@ -1843,6 +1843,29 @@ class CausalLMEngine(_AotEngine):
             )
         if float(payload.get("temperature", 0.0)) < 0.0:
             raise RequestError("temperature must be >= 0")
+        # Priority scheduling (serve/batcher.py): class 0 is the most
+        # urgent; deadline_ms is a TTFT deadline relative to enqueue that
+        # EDF admission orders on (and preemption rescues).
+        pri = payload.get("priority")
+        if pri is not None:
+            try:
+                pri = int(pri)
+            except (TypeError, ValueError):
+                raise RequestError("priority must be an integer") from None
+            if pri < 0:
+                raise RequestError(f"priority must be >= 0, got {pri}")
+        ddl = payload.get("deadline_ms")
+        if ddl is not None:
+            try:
+                ddl = float(ddl)
+            except (TypeError, ValueError):
+                raise RequestError(
+                    "deadline_ms must be a number of milliseconds"
+                ) from None
+            if not (ddl > 0.0):
+                raise RequestError(
+                    f"deadline_ms must be > 0, got {ddl}"
+                )
 
     def request_bucket(self, payload: dict) -> int:
         n = np.asarray(payload["input_ids"]).shape[0]

@@ -202,6 +202,67 @@ class KVBlockPool:
                 node = child
         return out
 
+    def index(self, token_ids) -> tuple[list[tuple[int, int]], int]:
+        """:meth:`insert` plus a coverage report: ``(new_pairs,
+        covered_blocks)`` where ``covered_blocks`` counts the full blocks
+        of ``token_ids`` present in the trie AFTER the insert. The
+        preemption park path needs the distinction insert alone cannot
+        give — allocation stops early under a full pool, and a parked
+        chain that only partially covers its sequence is useless (the
+        resume would still re-prefill the tail from the break point, but
+        the scheduler promised the victim a near-free resume and must
+        abort the preemption instead when the pool cannot hold it)."""
+        ids = [int(t) for t in token_ids]
+        bt = self.block_tokens
+        out: list[tuple[int, int]] = []
+        covered = 0
+        with self._lock:
+            self._ticks += 1
+            tick = self._ticks
+            node = self._root
+            for b in range(len(ids) // bt):
+                key = tuple(ids[b * bt:(b + 1) * bt])
+                child = node.children.get(key)
+                if child is None:
+                    block = self._alloc_locked()
+                    if block is None:
+                        break
+                    child = _TrieNode(key, block, node)
+                    node.children[key] = child
+                    self._by_block[block] = child
+                    out.append((block, b))
+                child.tick = tick
+                covered = b + 1
+                node = child
+        return out, covered
+
+    def forget(self, token_ids) -> int:
+        """Drop the trailing unpinned leaf run of ``token_ids``'s cached
+        chain (deepest-first, stopping at the first pinned or interior
+        node — prefix closure holds). The undo path for a park-publish
+        whose device copy failed AFTER :meth:`index` grew the trie: those
+        blocks advertise token content their pages never received, and
+        serving them would break bit-parity. Returns blocks freed."""
+        ids = [int(t) for t in token_ids]
+        bt = self.block_tokens
+        with self._lock:
+            node, chain = self._root, []
+            for b in range(len(ids) // bt):
+                child = node.children.get(tuple(ids[b * bt:(b + 1) * bt]))
+                if child is None:
+                    break
+                chain.append(child)
+                node = child
+            freed = 0
+            for n in reversed(chain):
+                if n.children or n.refs:
+                    break
+                del n.parent.children[n.key]
+                del self._by_block[n.block]
+                self._free.append(n.block)
+                freed += 1
+            return freed
+
     def _alloc_locked(self) -> int | None:
         if self._free:
             return self._free.pop()

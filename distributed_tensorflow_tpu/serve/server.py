@@ -41,7 +41,10 @@ Routes::
     POST /v1/classify-> image: top-k ids/probs for one example
     POST /v1/generate-> causal LM: generated tokens for one prompt
                         (continuous batching: the request joins the
-                        in-flight decode batch between steps)
+                        in-flight decode batch between steps; optional
+                        "priority" class + "deadline_ms" TTFT deadline
+                        drive EDF admission and slot preemption when the
+                        batcher runs --sched edf / --preempt)
 
 Every request gets a ``request_id`` (honoring an ``X-Request-Id`` header
 when the client sends one) that rides through the batcher into the engine

@@ -495,7 +495,10 @@ def test_sc001_real_package_axes_all_declared():
 def test_sc002_layout_sweep_proves_fallback_not_crash():
     findings, matrix = shardcheck.run_config_sweep()
     assert not findings
-    by_layout = {(c["tp"], c["pp"], c["ep"]): c["outcome"] for c in matrix}
+    by_layout = {
+        (c["tp"], c["pp"], c["ep"]): c["outcome"]
+        for c in matrix if "tp" in c
+    }
     # CLI defaults and the parity layouts serve.
     assert by_layout[(1, 1, 1)] == "serves"
     assert by_layout[(2, 1, 1)] == "serves"
@@ -506,6 +509,27 @@ def test_sc002_layout_sweep_proves_fallback_not_crash():
     assert by_layout[(3, 1, 1)] == "falls_back"
     # Infeasible model/layout combos die in a clean ValueError, never XLA.
     assert by_layout[(1, 1, 4)] == "rejects"
+    # Scheduler knob sweep (layout-independent, one cell): the valid rows
+    # plan, the designed-invalid rows reject with a clean ValueError, and
+    # non-FIFO / preempting configs are refused by the batcher shapes
+    # that cannot honor them — at build time, never mid-preemption.
+    sched = next(c for c in matrix if c.get("sweep") == "sched")
+    rows = {
+        (r["sched"], r["preempt"], r["preempt_margin_ms"],
+         r["default_priority"]): r
+        for r in sched["variants"]
+    }
+    assert len(rows) == len(shardcheck.SCHED_VARIANTS)
+    assert rows[("edf", True, 20.0, 1)]["plans"]
+    assert rows[("edf", True, 20.0, 1)]["flush_rejects"]
+    assert rows[("edf", False, 20.0, 0)]["dynamic_rejects"]
+    for bad in (
+        ("fifo", True, 20.0, 1),
+        ("lifo", False, 20.0, 1),
+        ("edf", True, -5.0, 1),
+        ("edf", False, 20.0, -1),
+    ):
+        assert "rejects" in rows[bad]
 
 
 # ---------------------------------------------------------------- sanitizer
